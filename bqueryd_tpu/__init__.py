@@ -1,0 +1,115 @@
+"""bqueryd_tpu — TPU-native distributed columnar query framework.
+
+A brand-new implementation of the capability set of visualfabriq/bqueryd
+(reference: /root/reference — a ZeroMQ/Redis controller+worker cluster that fans
+groupby/filter/aggregate queries over sharded bcolz column files and merges the
+partials; see reference bqueryd/__init__.py:1-24 for the surface re-exported here).
+
+Design differences from the reference (TPU-first, not a port):
+
+* Compute runs as jit'd JAX columnar kernels (factorized group keys +
+  ``segment_sum``) instead of Cython bquery kernels; shard partials merge with
+  ``jax.lax.psum`` over a device mesh instead of tar-and-re-aggregate.
+* Storage is a chunked, compressed columnar store with a C++ codec
+  (byte-shuffle + LZ4-class compression) replacing bcolz/Blosc, keeping the
+  same on-disk sharding semantics (``.bcolz`` / ``.bcolzs`` directories).
+* Coordination is pluggable: ``redis://`` (when redis-py is installed, matching
+  the reference deployment), ``mem://`` for in-process clusters (tests), and
+  ``file://`` for multi-process single-host clusters without a Redis server.
+* The wire protocol (JSON envelope + base64-pickled params, ``CalcMessage``
+  et al.) and the ``rpc.groupby(...)`` entrypoint are kept compatible.
+
+This module is intentionally light: no JAX import happens here so that pure
+control-plane processes (controller, downloader) never pay for it.  Kernel
+modules (``bqueryd_tpu.ops``, ``bqueryd_tpu.parallel``) import JAX lazily and
+enable 64-bit mode for bit-exact int64 aggregates.
+
+Unlike the reference (reference bqueryd/__init__.py:13-15) importing this
+package has NO filesystem side effects; directories are created at node start.
+"""
+
+import logging
+import os
+
+logger = logging.getLogger("bqueryd_tpu")
+logger.addHandler(logging.NullHandler())
+
+
+def configure_logging(loglevel=logging.INFO):
+    """Attach the framework's stream handler and set the root logger level.
+
+    Called by node constructors and the CLI — NOT at import time, so embedding
+    applications keep control of their logging config.  (The reference
+    configured a stream handler as an import side effect, reference
+    bqueryd/__init__.py:6-10.)
+    """
+    has_stream = any(
+        isinstance(h, logging.StreamHandler)
+        and not isinstance(h, logging.NullHandler)
+        for h in logger.handlers
+    )
+    if not has_stream:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+    logger.setLevel(loglevel)
+
+#: Root of served shard directories (reference bqueryd/__init__.py:12).
+DEFAULT_DATA_DIR = os.environ.get("BQUERYD_TPU_DATA_DIR", "/srv/bcolz/")
+#: Staging area for in-flight downloads (reference bqueryd/__init__.py:13).
+INCOMING = os.path.join(DEFAULT_DATA_DIR, "incoming")
+
+#: Coordination-store key names are kept identical to the reference
+#: (reference bqueryd/__init__.py:17-19) so a redis-backed deployment of this
+#: framework is observable with the same tooling.
+REDIS_SET_KEY = "bqueryd_controllers"
+REDIS_TICKET_KEY_PREFIX = "bqueryd_download_ticket_"
+REDIS_DOWNLOAD_LOCK_PREFIX = "bqueryd_download_lock_"
+#: TTL for download locks, seconds (reference bqueryd/__init__.py:20).
+REDIS_DOWNLOAD_LOCK_DURATION = 60 * 30
+
+DEFAULT_COORDINATION_URL = os.environ.get(
+    "BQUERYD_TPU_COORDINATION_URL", "redis://127.0.0.1:6379/0"
+)
+
+from bqueryd_tpu.version import __version__  # noqa: E402
+
+_LAZY_EXPORTS = {
+    "RPC": ("bqueryd_tpu.rpc", "RPC"),
+    "ControllerNode": ("bqueryd_tpu.controller", "ControllerNode"),
+    "WorkerNode": ("bqueryd_tpu.worker", "WorkerNode"),
+    "DownloaderNode": ("bqueryd_tpu.worker", "DownloaderNode"),
+    "MoveBcolzNode": ("bqueryd_tpu.worker", "MoveBcolzNode"),
+}
+
+
+def __getattr__(name):
+    # PEP 562 lazy re-exports: keep `import bqueryd_tpu` light for pure
+    # control-plane processes (the reference eagerly imported every role,
+    # reference bqueryd/__init__.py:22-24).
+    if name in _LAZY_EXPORTS:
+        import importlib
+
+        module, attr = _LAZY_EXPORTS[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module 'bqueryd_tpu' has no attribute {name!r}")
+
+__all__ = [
+    "RPC",
+    "ControllerNode",
+    "WorkerNode",
+    "DownloaderNode",
+    "MoveBcolzNode",
+    "logger",
+    "DEFAULT_DATA_DIR",
+    "INCOMING",
+    "REDIS_SET_KEY",
+    "REDIS_TICKET_KEY_PREFIX",
+    "REDIS_DOWNLOAD_LOCK_PREFIX",
+    "REDIS_DOWNLOAD_LOCK_DURATION",
+    "__version__",
+]

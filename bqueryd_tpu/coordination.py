@@ -1,0 +1,446 @@
+"""Pluggable coordination store (cluster membership, tickets, locks).
+
+The reference uses a Redis server for all host-side coordination: the
+controller membership set (reference bqueryd/controller.py:79-81), download
+ticket hashes (reference bqueryd/controller.py:457-462) and per-file
+distributed locks with a TTL (reference bqueryd/worker.py:400-416).  This
+framework keeps that architecture but abstracts the store behind one small
+interface so clusters can run without a Redis server:
+
+* ``redis://...``  — real Redis via redis-py, for production parity.
+* ``mem://<name>`` — process-local store shared by name; the in-process
+  thread-cluster test topology (the reference's own test strategy, reference
+  tests/test_simple_rpc.py:42-74) uses this.
+* ``file:///path`` — filesystem-backed store with POSIX-lock serialized
+  updates, for multi-process single-host clusters.
+
+Only the operations the framework needs are exposed: string sets, string
+hashes, key scans, deletes, and TTL locks.  All values are ``str``.
+"""
+
+import fnmatch
+import json
+import os
+import threading
+import time
+
+__all__ = ["coordination_store", "CoordinationStore", "StoreLock"]
+
+
+class StoreLock:
+    """A named lock with a TTL, mirroring redis-py's ``Lock`` surface
+    (``acquire(blocking=False)`` / ``release()``) used at reference
+    bqueryd/worker.py:400-416.  Expired locks are claimable by others."""
+
+    def __init__(self, store, name, ttl):
+        self.store = store
+        self.name = name
+        self.ttl = ttl
+        self.token = os.urandom(8).hex()
+
+    def acquire(self, blocking=True, timeout=None):
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if self.store._lock_acquire(self.name, self.token, self.ttl):
+                return True
+            if not blocking:
+                return False
+            if deadline is not None and time.time() > deadline:
+                return False
+            time.sleep(0.05)
+
+    def release(self):
+        self.store._lock_release(self.name, self.token)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class CoordinationStore:
+    """Abstract store; see module docstring for the operation set."""
+
+    url = None
+
+    # sets
+    def sadd(self, key, member):
+        raise NotImplementedError
+
+    def srem(self, key, member):
+        raise NotImplementedError
+
+    def smembers(self, key):
+        raise NotImplementedError
+
+    # hashes
+    def hset(self, key, field, value):
+        raise NotImplementedError
+
+    def hget(self, key, field):
+        raise NotImplementedError
+
+    def hgetall(self, key):
+        raise NotImplementedError
+
+    def hdel(self, key, *fields):
+        raise NotImplementedError
+
+    # keys
+    def keys(self, pattern="*"):
+        raise NotImplementedError
+
+    def delete(self, *keys):
+        raise NotImplementedError
+
+    def flushdb(self):
+        raise NotImplementedError
+
+    # locks
+    def lock(self, name, ttl):
+        return StoreLock(self, name, ttl)
+
+    def _lock_acquire(self, name, token, ttl):
+        raise NotImplementedError
+
+    def _lock_release(self, name, token):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# mem:// — shared-by-name in-process store
+# ---------------------------------------------------------------------------
+
+class _MemState:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.sets = {}
+        self.hashes = {}
+        self.locks = {}  # name -> (token, expiry)
+
+
+_MEM_REGISTRY = {}
+_MEM_REGISTRY_LOCK = threading.Lock()
+
+
+class MemoryStore(CoordinationStore):
+    def __init__(self, url):
+        self.url = url
+        with _MEM_REGISTRY_LOCK:
+            self._state = _MEM_REGISTRY.setdefault(url, _MemState())
+
+    def sadd(self, key, member):
+        with self._state.lock:
+            self._state.sets.setdefault(key, set()).add(str(member))
+
+    def srem(self, key, member):
+        with self._state.lock:
+            self._state.sets.get(key, set()).discard(str(member))
+
+    def smembers(self, key):
+        with self._state.lock:
+            return set(self._state.sets.get(key, set()))
+
+    def hset(self, key, field, value):
+        with self._state.lock:
+            self._state.hashes.setdefault(key, {})[str(field)] = str(value)
+
+    def hget(self, key, field):
+        with self._state.lock:
+            return self._state.hashes.get(key, {}).get(str(field))
+
+    def hgetall(self, key):
+        with self._state.lock:
+            return dict(self._state.hashes.get(key, {}))
+
+    def hdel(self, key, *fields):
+        with self._state.lock:
+            h = self._state.hashes.get(key, {})
+            for f in fields:
+                h.pop(str(f), None)
+            if not h:
+                self._state.hashes.pop(key, None)
+
+    def keys(self, pattern="*"):
+        now = time.time()
+        with self._state.lock:
+            live_locks = {
+                k for k, (_tok, exp) in self._state.locks.items() if exp > now
+            }
+            names = set(self._state.sets) | set(self._state.hashes) | live_locks
+            return [k for k in names if fnmatch.fnmatchcase(k, pattern)]
+
+    def delete(self, *keys):
+        with self._state.lock:
+            for k in keys:
+                self._state.sets.pop(k, None)
+                self._state.hashes.pop(k, None)
+                self._state.locks.pop(k, None)
+
+    def flushdb(self):
+        with self._state.lock:
+            self._state.sets.clear()
+            self._state.hashes.clear()
+            self._state.locks.clear()
+
+    def _lock_acquire(self, name, token, ttl):
+        now = time.time()
+        with self._state.lock:
+            held = self._state.locks.get(name)
+            if held is not None and held[1] > now and held[0] != token:
+                return False
+            self._state.locks[name] = (token, now + ttl)
+            return True
+
+    def _lock_release(self, name, token):
+        with self._state.lock:
+            held = self._state.locks.get(name)
+            if held is not None and held[0] == token:
+                self._state.locks.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# file:// — filesystem-backed store (multi-process, single host)
+# ---------------------------------------------------------------------------
+
+class FileStore(CoordinationStore):
+    """One JSON file per key under the root dir; every mutation runs under an
+    ``fcntl`` flock on ``<root>/.store.lock`` so concurrent processes
+    serialize.  Key names are encoded to stay filesystem-safe."""
+
+    def __init__(self, url):
+        self.url = url
+        self.root = url[len("file://"):] or "/tmp/bqueryd_tpu_store"
+        os.makedirs(self.root, exist_ok=True)
+        self._guard_path = os.path.join(self.root, ".store.lock")
+
+    def _enc(self, key):
+        return key.replace("/", "%2F") + ".json"
+
+    def _dec(self, fname):
+        return fname[:-5].replace("%2F", "/")
+
+    class _Guard:
+        def __init__(self, path):
+            self.path = path
+
+        def __enter__(self):
+            import fcntl
+
+            self.fd = open(self.path, "a+")
+            fcntl.flock(self.fd, fcntl.LOCK_EX)
+            return self
+
+        def __exit__(self, *exc):
+            import fcntl
+
+            fcntl.flock(self.fd, fcntl.LOCK_UN)
+            self.fd.close()
+
+    def _guard(self):
+        return FileStore._Guard(self._guard_path)
+
+    def _load(self, key):
+        path = os.path.join(self.root, self._enc(key))
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            try:
+                return json.load(f)
+            except ValueError:
+                return None
+
+    def _save(self, key, obj):
+        path = os.path.join(self.root, self._enc(key))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+
+    def _remove(self, key):
+        path = os.path.join(self.root, self._enc(key))
+        if os.path.exists(path):
+            os.remove(path)
+
+    def sadd(self, key, member):
+        with self._guard():
+            obj = self._load(key) or {"type": "set", "v": []}
+            if str(member) not in obj["v"]:
+                obj["v"].append(str(member))
+            self._save(key, obj)
+
+    def srem(self, key, member):
+        with self._guard():
+            obj = self._load(key)
+            if obj and str(member) in obj["v"]:
+                obj["v"].remove(str(member))
+                self._save(key, obj)
+
+    def smembers(self, key):
+        with self._guard():
+            obj = self._load(key)
+            return set(obj["v"]) if obj else set()
+
+    def hset(self, key, field, value):
+        with self._guard():
+            obj = self._load(key) or {"type": "hash", "v": {}}
+            obj["v"][str(field)] = str(value)
+            self._save(key, obj)
+
+    def hget(self, key, field):
+        with self._guard():
+            obj = self._load(key)
+            return obj["v"].get(str(field)) if obj else None
+
+    def hgetall(self, key):
+        with self._guard():
+            obj = self._load(key)
+            return dict(obj["v"]) if obj else {}
+
+    def hdel(self, key, *fields):
+        with self._guard():
+            obj = self._load(key)
+            if not obj:
+                return
+            for f in fields:
+                obj["v"].pop(str(f), None)
+            if obj["v"]:
+                self._save(key, obj)
+            else:
+                self._remove(key)
+
+    def keys(self, pattern="*"):
+        with self._guard():
+            names = [
+                self._dec(f)
+                for f in os.listdir(self.root)
+                if f.endswith(".json") and not f.startswith(".")
+            ]
+            return [k for k in names if fnmatch.fnmatchcase(k, pattern)]
+
+    def delete(self, *keys):
+        with self._guard():
+            for k in keys:
+                self._remove(k)
+
+    def flushdb(self):
+        with self._guard():
+            for f in os.listdir(self.root):
+                if f.endswith(".json"):
+                    os.remove(os.path.join(self.root, f))
+
+    def _lock_acquire(self, name, token, ttl):
+        # Locks are ordinary keys (visible to keys(), clearable with delete()),
+        # matching how they would appear on a real Redis deployment.
+        now = time.time()
+        with self._guard():
+            obj = self._load(name)
+            if (
+                obj
+                and obj.get("type") == "lock"
+                and obj["v"].get("expiry", 0) > now
+                and obj["v"].get("token") != token
+            ):
+                return False
+            self._save(name, {"type": "lock", "v": {"token": token, "expiry": now + ttl}})
+            return True
+
+    def _lock_release(self, name, token):
+        with self._guard():
+            obj = self._load(name)
+            if obj and obj.get("type") == "lock" and obj["v"].get("token") == token:
+                self._remove(name)
+
+
+# ---------------------------------------------------------------------------
+# redis:// — real Redis (gated on redis-py being installed)
+# ---------------------------------------------------------------------------
+
+class RedisStore(CoordinationStore):
+    def __init__(self, url):
+        import redis  # gated import: optional dependency
+
+        self.url = url
+        self._r = redis.from_url(url, decode_responses=True)
+
+    def sadd(self, key, member):
+        self._r.sadd(key, member)
+
+    def srem(self, key, member):
+        self._r.srem(key, member)
+
+    def smembers(self, key):
+        return set(self._r.smembers(key))
+
+    def hset(self, key, field, value):
+        self._r.hset(key, field, value)
+
+    def hget(self, key, field):
+        return self._r.hget(key, field)
+
+    def hgetall(self, key):
+        return self._r.hgetall(key)
+
+    def hdel(self, key, *fields):
+        if fields:
+            self._r.hdel(key, *fields)
+
+    def keys(self, pattern="*"):
+        return list(self._r.keys(pattern))
+
+    def delete(self, *keys):
+        if keys:
+            self._r.delete(*keys)
+
+    def flushdb(self):
+        self._r.flushdb()
+
+    def lock(self, name, ttl):
+        return _RedisLockAdapter(self._r.lock(name, timeout=ttl))
+
+
+class _RedisLockAdapter:
+    """Presents redis-py's Lock with the StoreLock surface so code written
+    against mem:///file:// behaves identically on redis://: ``acquire``'s
+    ``timeout`` means overall blocking time (redis-py calls it
+    ``blocking_timeout``), and releasing an expired lock is a silent no-op
+    (redis-py raises LockError; the reference had to catch it explicitly at
+    reference bqueryd/worker.py:407-411)."""
+
+    def __init__(self, redis_lock):
+        self._lock = redis_lock
+
+    def acquire(self, blocking=True, timeout=None):
+        return self._lock.acquire(blocking=blocking, blocking_timeout=timeout)
+
+    def release(self):
+        import redis.exceptions
+
+        try:
+            self._lock.release()
+        except redis.exceptions.LockError:
+            pass
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def coordination_store(url):
+    """Construct the right backend for ``url``.  Accepts an existing store
+    instance unchanged so tests can inject doubles (the reference's
+    subclass-level seam strategy, SURVEY.md §4)."""
+    if isinstance(url, CoordinationStore):
+        return url
+    if url.startswith("mem://"):
+        return MemoryStore(url)
+    if url.startswith("file://"):
+        return FileStore(url)
+    if url.startswith("redis://") or url.startswith("rediss://"):
+        return RedisStore(url)
+    raise ValueError(f"unsupported coordination url: {url!r}")

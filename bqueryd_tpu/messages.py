@@ -1,0 +1,156 @@
+"""Wire protocol: JSON dict envelopes with base64-pickled binary fields.
+
+Format-compatible with the reference protocol (reference bqueryd/messages.py:1-102):
+a message is a plain dict serialized to JSON with at least ``msg_type``,
+``payload``, ``version`` and ``created`` keys; call parameters travel as a
+pickled ``{'args': ..., 'kwargs': ...}`` dict, base64-encoded, under the
+``params`` key.  ``msg_factory`` maps ``msg_type`` strings to classes using the
+same type names (``calc``, ``rpc``, ``error``, ``worker_register``, ``busy``,
+``done``, ``ticketdone``, ``stop``).
+
+Deliberate fixes over the reference (flagged in SURVEY.md §7.4):
+
+* parse failures raise :class:`MalformedMessage` instead of the silent
+  ``msg is None`` dead statement (reference bqueryd/messages.py:11);
+  callers that want the lenient behaviour use ``msg_factory(..., strict=False)``.
+* binary values are pickled with an explicit protocol so Python 3 nodes of
+  mixed minor versions interoperate.
+
+Security note: like the reference (reference README.md:129) pickled payloads
+assume a trusted network.  ``Message.get_from_binary`` is the single choke
+point, so a restricted unpickler can be installed here later.
+"""
+
+import base64
+import json
+import pickle
+import time
+
+PICKLE_PROTOCOL = 4
+
+
+class MalformedMessage(Exception):
+    pass
+
+
+class Message(dict):
+    """A message is a dict; subclasses only pin ``msg_type``."""
+
+    msg_type = None
+
+    def __init__(self, datadict=None):
+        super().__init__()
+        if not datadict:
+            datadict = {}
+        self.update(datadict)
+        self["payload"] = datadict.get("payload")
+        self["version"] = datadict.get("version", 1)
+        self["msg_type"] = self.msg_type
+        # Preserve the sender's timestamp across parse/copy so envelope age is
+        # measurable; only stamp fresh messages.  (The reference re-stamped on
+        # every parse, reference bqueryd/messages.py:37.)
+        self["created"] = datadict.get("created", time.time())
+
+    def copy(self):
+        return msg_factory(dict(self))
+
+    def isa(self, payload_or_class):
+        """True if this message's type matches ``payload_or_class`` (a Message
+        subclass) or its payload equals it (a string verb)."""
+        if self.msg_type is not None and self.msg_type == getattr(
+            payload_or_class, "msg_type", "_"
+        ):
+            return True
+        return self.get("payload") == payload_or_class
+
+    # -- binary fields -----------------------------------------------------
+    def add_as_binary(self, key, value):
+        self[key] = base64.b64encode(
+            pickle.dumps(value, protocol=PICKLE_PROTOCOL)
+        ).decode("ascii")
+
+    def get_from_binary(self, key, default=None):
+        buf = self.get(key)
+        if not buf:
+            return default
+        if isinstance(buf, str):
+            buf = buf.encode("ascii")
+        return pickle.loads(base64.b64decode(buf))
+
+    # -- call params -------------------------------------------------------
+    def set_args_kwargs(self, args, kwargs):
+        self.add_as_binary("params", {"args": args, "kwargs": kwargs})
+
+    def get_args_kwargs(self):
+        params = self.get_from_binary("params", {})
+        return params.get("args", []), params.get("kwargs", {})
+
+    def to_json(self):
+        return json.dumps(self)
+
+
+class WorkerRegisterMessage(Message):
+    msg_type = "worker_register"
+
+
+class CalcMessage(Message):
+    msg_type = "calc"
+
+
+class RPCMessage(Message):
+    msg_type = "rpc"
+
+
+class ErrorMessage(Message):
+    msg_type = "error"
+
+
+class BusyMessage(Message):
+    msg_type = "busy"
+
+
+class DoneMessage(Message):
+    msg_type = "done"
+
+
+class StopMessage(Message):
+    msg_type = "stop"
+
+
+class TicketDoneMessage(Message):
+    msg_type = "ticketdone"
+
+
+MSG_MAPPING = {
+    "calc": CalcMessage,
+    "rpc": RPCMessage,
+    "error": ErrorMessage,
+    "worker_register": WorkerRegisterMessage,
+    "busy": BusyMessage,
+    "done": DoneMessage,
+    "ticketdone": TicketDoneMessage,
+    "stop": StopMessage,
+    None: Message,
+}
+
+
+def msg_factory(msg, strict=True):
+    """Parse ``msg`` (JSON str/bytes or dict) into the right Message subclass.
+
+    Same dispatch table as the reference factory (reference
+    bqueryd/messages.py:14-20); unknown ``msg_type`` values map to the base
+    class so protocol extensions degrade gracefully.
+    """
+    if isinstance(msg, bytes):
+        msg = msg.decode("utf-8", errors="replace")
+    if isinstance(msg, str):
+        try:
+            msg = json.loads(msg)
+        except ValueError as exc:
+            if strict:
+                raise MalformedMessage(f"unparseable message: {exc}") from exc
+            msg = None
+    if not msg:
+        return Message()
+    msg_class = MSG_MAPPING.get(msg.get("msg_type"), Message)
+    return msg_class(msg)

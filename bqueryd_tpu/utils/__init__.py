@@ -1,0 +1,21 @@
+from bqueryd_tpu.utils.fs import mkdir_p, rm_file_or_dir
+from bqueryd_tpu.utils.net import (
+    bind_to_random_port,
+    get_my_ip,
+    show_workers,
+    tree_checksum,
+    zip_to_file,
+)
+from bqueryd_tpu.utils.tracing import PhaseTimer, trace_span
+
+__all__ = [
+    "mkdir_p",
+    "rm_file_or_dir",
+    "bind_to_random_port",
+    "get_my_ip",
+    "show_workers",
+    "tree_checksum",
+    "zip_to_file",
+    "PhaseTimer",
+    "trace_span",
+]

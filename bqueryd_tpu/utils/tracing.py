@@ -1,0 +1,71 @@
+"""Per-phase timing and profiler hooks.
+
+The reference's only timing surface is a per-call wall clock on the client
+(reference bqueryd/rpc.py:128-129).  The TPU build needs to attribute a query's
+latency to its phases — storage decode, host→device transfer, kernel, and
+collective merge — so workers attach a :class:`PhaseTimer` to every calc result
+(surfaced in the reply under ``phase_timings``) and expose an opt-in
+``jax.profiler`` trace hook.
+"""
+
+import contextlib
+import os
+import time
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; phases may recur (times sum)."""
+
+    def __init__(self):
+        self.timings = {}
+        self._started = time.time()
+
+    @contextlib.contextmanager
+    def phase(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings[name] = self.timings.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def total(self):
+        return time.time() - self._started
+
+    def as_dict(self):
+        out = dict(self.timings)
+        out["total"] = self.total()
+        return out
+
+
+@contextlib.contextmanager
+def trace_span(name):
+    """A ``jax.profiler.TraceAnnotation`` span when JAX is importable and
+    profiling is enabled via BQUERYD_TPU_PROFILE=1; otherwise a no-op."""
+    annotation = None
+    if os.environ.get("BQUERYD_TPU_PROFILE") == "1":
+        try:
+            import jax.profiler
+        except ImportError:
+            pass
+        else:
+            annotation = jax.profiler.TraceAnnotation(name)
+    if annotation is not None:
+        with annotation:
+            yield
+    else:
+        yield
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir):
+    """Capture a full ``jax.profiler`` trace (TensorBoard format) around a
+    block — the TPU-side replacement for eyeballing ``last_call_duration``."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh: the env vars must
+be set before JAX initializes its backends, which is why they live at conftest
+import time rather than in a fixture.  Real-TPU runs happen in ``bench.py``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import time
+
+import pytest
+
+
+def wait_until(predicate, timeout=30.0, interval=0.05, desc="condition"):
+    """Poll ``predicate`` until truthy; the framework-wide replacement for the
+    reference's sleep-based test synchronization (SURVEY.md §4)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+
+
+@pytest.fixture
+def mem_store_url():
+    """A fresh, flushed mem:// coordination store per test."""
+    from bqueryd_tpu.coordination import coordination_store
+
+    url = f"mem://test-{os.urandom(4).hex()}"
+    store = coordination_store(url)
+    store.flushdb()
+    return url

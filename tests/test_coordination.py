@@ -1,0 +1,102 @@
+import threading
+
+import pytest
+
+from bqueryd_tpu.coordination import coordination_store
+
+
+@pytest.fixture(params=["mem", "file"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        url = f"mem://coord-test-{id(request)}"
+    else:
+        url = f"file://{tmp_path}/store"
+    s = coordination_store(url)
+    s.flushdb()
+    return s
+
+
+def test_set_ops(store):
+    store.sadd("controllers", "tcp://1.2.3.4:14300")
+    store.sadd("controllers", "tcp://1.2.3.4:14301")
+    store.sadd("controllers", "tcp://1.2.3.4:14300")
+    assert store.smembers("controllers") == {
+        "tcp://1.2.3.4:14300",
+        "tcp://1.2.3.4:14301",
+    }
+    store.srem("controllers", "tcp://1.2.3.4:14300")
+    assert store.smembers("controllers") == {"tcp://1.2.3.4:14301"}
+    store.srem("controllers", "never-added")  # no-op
+
+
+def test_hash_ops(store):
+    store.hset("ticket_x", "node1_s3://b/f", "123_-1")
+    store.hset("ticket_x", "node2_s3://b/f", "124_-1")
+    store.hset("ticket_x", "node1_s3://b/f", "125_DONE")
+    assert store.hget("ticket_x", "node1_s3://b/f") == "125_DONE"
+    assert store.hgetall("ticket_x") == {
+        "node1_s3://b/f": "125_DONE",
+        "node2_s3://b/f": "124_-1",
+    }
+    store.hdel("ticket_x", "node1_s3://b/f")
+    assert "node1_s3://b/f" not in store.hgetall("ticket_x")
+
+
+def test_keys_pattern_and_delete(store):
+    store.hset("bqueryd_download_ticket_aa", "f", "1")
+    store.hset("bqueryd_download_ticket_bb", "f", "1")
+    store.sadd("bqueryd_controllers", "x")
+    tickets = sorted(store.keys("bqueryd_download_ticket_*"))
+    assert tickets == ["bqueryd_download_ticket_aa", "bqueryd_download_ticket_bb"]
+    store.delete("bqueryd_download_ticket_aa")
+    assert store.keys("bqueryd_download_ticket_*") == ["bqueryd_download_ticket_bb"]
+
+
+def test_lock_mutual_exclusion(store):
+    l1 = store.lock("dl_lock", ttl=60)
+    l2 = store.lock("dl_lock", ttl=60)
+    assert l1.acquire(blocking=False)
+    assert not l2.acquire(blocking=False)
+    l1.release()
+    assert l2.acquire(blocking=False)
+    l2.release()
+
+
+def test_lock_ttl_expiry(store, monkeypatch):
+    import time as time_mod
+
+    l1 = store.lock("dl_lock", ttl=0.05)
+    assert l1.acquire(blocking=False)
+    time_mod.sleep(0.1)
+    l2 = store.lock("dl_lock", ttl=60)
+    assert l2.acquire(blocking=False), "expired lock must be claimable"
+    l2.release()
+
+
+def test_mem_store_shared_by_url():
+    a = coordination_store("mem://shared-url-test")
+    b = coordination_store("mem://shared-url-test")
+    a.flushdb()
+    a.sadd("k", "v")
+    assert b.smembers("k") == {"v"}
+
+
+def test_concurrent_lock_single_winner(store):
+    wins = []
+
+    def contender():
+        lock = store.lock("race", ttl=60)
+        if lock.acquire(blocking=False):
+            wins.append(1)
+
+    threads = [threading.Thread(target=contender) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+
+
+def test_bad_url_rejected():
+    with pytest.raises(ValueError):
+        coordination_store("carrier-pigeon://coop")

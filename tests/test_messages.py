@@ -1,0 +1,84 @@
+import json
+
+import pytest
+
+from bqueryd_tpu import messages
+
+
+def test_factory_dispatch_all_types():
+    for name, cls in messages.MSG_MAPPING.items():
+        if name is None:
+            continue
+        msg = messages.msg_factory(json.dumps({"msg_type": name}))
+        assert isinstance(msg, cls)
+        assert msg["msg_type"] == name
+
+
+def test_factory_accepts_bytes_dict_and_none():
+    assert isinstance(messages.msg_factory(b'{"msg_type": "busy"}'), messages.BusyMessage)
+    assert isinstance(messages.msg_factory({"msg_type": "done"}), messages.DoneMessage)
+    assert type(messages.msg_factory(None)) is messages.Message
+    assert type(messages.msg_factory({})) is messages.Message
+
+
+def test_factory_unknown_type_degrades_to_base():
+    msg = messages.msg_factory({"msg_type": "from-the-future"})
+    assert type(msg) is messages.Message
+
+
+def test_factory_strict_raises_on_garbage():
+    with pytest.raises(messages.MalformedMessage):
+        messages.msg_factory("not json {{{")
+    assert type(messages.msg_factory("not json {{{", strict=False)) is messages.Message
+
+
+def test_wire_roundtrip_preserves_params():
+    msg = messages.RPCMessage({"payload": "groupby", "token": "abcd"})
+    args = (["file.bcolz"], ["payment_type"], [["total_amount", "sum", "total_amount"]], [])
+    kwargs = {"aggregate": True}
+    msg.set_args_kwargs(args, kwargs)
+
+    wire = msg.to_json()
+    parsed = messages.msg_factory(wire)
+    assert isinstance(parsed, messages.RPCMessage)
+    got_args, got_kwargs = parsed.get_args_kwargs()
+    assert list(got_args) == list(args)
+    assert got_kwargs == kwargs
+    assert parsed["token"] == "abcd"
+
+
+def test_wire_format_shape():
+    """The JSON envelope keeps the reference's field contract: msg_type,
+    payload, version, created at top level; params is a base64 string."""
+    msg = messages.CalcMessage({"payload": "groupby"})
+    msg.set_args_kwargs([1], {})
+    d = json.loads(msg.to_json())
+    assert d["msg_type"] == "calc"
+    assert d["payload"] == "groupby"
+    assert d["version"] == 1
+    assert isinstance(d["created"], float)
+    assert isinstance(d["params"], str)  # base64 text, JSON-safe
+
+
+def test_isa_matches_class_and_payload():
+    msg = messages.RPCMessage({"payload": "info"})
+    assert msg.isa(messages.RPCMessage)
+    assert msg.isa("info")
+    assert not msg.isa(messages.CalcMessage)
+    assert not msg.isa("groupby")
+
+
+def test_copy_preserves_class():
+    msg = messages.CalcMessage({"payload": "groupby"})
+    clone = msg.copy()
+    assert isinstance(clone, messages.CalcMessage)
+    clone["payload"] = "other"
+    assert msg["payload"] == "groupby"
+
+
+def test_binary_field_roundtrip():
+    msg = messages.Message()
+    payload = {"arr": [1, 2, 3], "nested": {"x": b"\x00\xff"}}
+    msg.add_as_binary("data", payload)
+    assert messages.msg_factory(msg.to_json()).get_from_binary("data") == payload
+    assert msg.get_from_binary("absent", "fallback") == "fallback"

@@ -1,0 +1,64 @@
+import os
+import zipfile
+
+from bqueryd_tpu.utils import (
+    get_my_ip,
+    mkdir_p,
+    rm_file_or_dir,
+    tree_checksum,
+    zip_to_file,
+)
+
+
+def test_get_my_ip_returns_ipv4():
+    ip = get_my_ip()
+    parts = ip.split(".")
+    assert len(parts) == 4
+    assert all(0 <= int(p) <= 255 for p in parts)
+
+
+def test_mkdir_p_idempotent(tmp_path):
+    target = tmp_path / "a" / "b" / "c"
+    mkdir_p(str(target))
+    mkdir_p(str(target))
+    assert target.is_dir()
+
+
+def test_rm_file_or_dir(tmp_path):
+    f = tmp_path / "f.txt"
+    f.write_text("x")
+    d = tmp_path / "d"
+    (d / "sub").mkdir(parents=True)
+    link = tmp_path / "lnk"
+    os.symlink(str(d), str(link))
+
+    rm_file_or_dir(str(link))
+    assert not link.exists() and d.exists()
+    rm_file_or_dir(str(f))
+    rm_file_or_dir(str(d))
+    rm_file_or_dir(str(tmp_path / "never-existed"))
+    assert not f.exists() and not d.exists()
+
+
+def test_zip_to_file_dir_roundtrip(tmp_path):
+    src = tmp_path / "shard.bcolz"
+    (src / "col").mkdir(parents=True)
+    (src / "col" / "chunk0").write_bytes(b"\x01\x02\x03")
+    (src / "meta.json").write_text("{}")
+
+    dest = tmp_path / "out"
+    dest.mkdir()
+    zip_name, checksum = zip_to_file(str(src), str(dest))
+    assert checksum.startswith("0x")
+    with zipfile.ZipFile(zip_name) as zf:
+        names = set(zf.namelist())
+    assert names == {"col/chunk0", "meta.json"}
+
+
+def test_tree_checksum_changes_with_structure(tmp_path):
+    (tmp_path / "a").write_text("1")
+    c1 = tree_checksum(str(tmp_path))
+    (tmp_path / "b").write_text("2")
+    c2 = tree_checksum(str(tmp_path))
+    assert c1 != c2
+    assert tree_checksum(str(tmp_path)) == c2
