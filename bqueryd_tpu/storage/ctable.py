@@ -1,0 +1,402 @@
+"""tpucolz ctable: chunked, compressed, columnar on-disk tables.
+
+The storage role bcolz plays in the reference (opened at reference
+bqueryd/worker.py:291, written by tests via ``ctable.fromdataframe``,
+reference tests/test_simple_rpc.py:78-99), redesigned for the TPU data path:
+
+* **single data file per column** (``cols/<name>/data.tpc``) holding
+  back-to-back compressed chunks plus a JSON chunk index — one sequential read
+  per column, then a multithreaded native decode straight into one contiguous
+  host buffer sized for a single host→device transfer;
+* **dictionary encoding at ingest** for string/category columns: the physical
+  column is dense int32 codes and the dictionary is stored beside it.  Group
+  keys are therefore *pre-factorized on disk*, which is what the TPU kernels
+  want (TPUs can't factorize strings) and subsumes bquery's on-disk
+  factorization cache;
+* **datetimes stored as int64 nanoseconds** (TPU-friendly), reconstructed on
+  the way out;
+* same sharding semantics as the reference: a table is a directory named
+  ``*.bcolz`` (full table) or ``*.bcolzs`` (shard), discovered by workers
+  scanning their data_dir.
+
+Layout::
+
+    <root>/
+      meta.json                  format header, nrows, column order
+      __attrs__.json             user attrs (provenance metadata etc.)
+      cols/<enc(name)>/meta.json chunk index: [{offset,csize,usize,nrows}...]
+      cols/<enc(name)>/data.tpc  compressed chunks, back to back
+      cols/<enc(name)>/dictionary.json   (dict-encoded columns only)
+"""
+
+import json
+import os
+import threading
+import zlib
+
+import numpy as np
+
+from bqueryd_tpu.storage import codec
+from bqueryd_tpu.utils.fs import mkdir_p, rm_file_or_dir
+
+FORMAT_NAME = "tpucolz"
+FORMAT_VERSION = 1
+DEFAULT_CHUNKLEN = 1 << 18  # rows per chunk
+
+KIND_NUMERIC = "numeric"
+KIND_DICT = "dict"
+KIND_DATETIME = "datetime"
+
+
+def _pd():
+    import pandas as pd
+
+    return pd
+
+
+def _atomic_json_dump(obj, path):
+    """Write-then-rename so a crash mid-write never truncates committed data."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _enc_name(name):
+    out = []
+    for ch in name:
+        if ch.isalnum() or ch in "._-":
+            out.append(ch)
+        else:
+            out.append("%%%02X" % ord(ch))
+    return "".join(out)
+
+
+class _ColumnMeta:
+    def __init__(self, name, kind, dtype, chunks=None):
+        self.name = name
+        self.kind = kind
+        self.dtype = dtype  # physical numpy dtype string, e.g. "<i8"
+        self.chunks = chunks or []
+
+    def to_json(self):
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "dtype": self.dtype,
+            "chunks": self.chunks,
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(d["name"], d["kind"], d["dtype"], d["chunks"])
+
+
+# Process-wide decoded-column cache: the in-memory analogue of bquery's
+# auto_cache (reference bqueryd/worker.py:291).  Keyed by (realpath, column,
+# data-file mtime+size) so reshard/activation invalidates naturally.
+_COLUMN_CACHE = {}
+_COLUMN_CACHE_LOCK = threading.Lock()
+_COLUMN_CACHE_MAX_BYTES = int(
+    os.environ.get("BQUERYD_TPU_COLUMN_CACHE_BYTES", 2 * 1024**3)
+)
+_column_cache_bytes = 0
+
+
+def free_cachemem():
+    """Drop the process-wide decoded-column cache (parity with bquery's
+    ``free_cachemem``, called post-task at reference bqueryd/worker.py:330)."""
+    global _column_cache_bytes
+    with _COLUMN_CACHE_LOCK:
+        _COLUMN_CACHE.clear()
+        _column_cache_bytes = 0
+
+
+def _cache_get(key):
+    with _COLUMN_CACHE_LOCK:
+        return _COLUMN_CACHE.get(key)
+
+
+def _cache_put(key, arr):
+    global _column_cache_bytes
+    with _COLUMN_CACHE_LOCK:
+        if key in _COLUMN_CACHE:
+            return
+        nbytes = arr.nbytes
+        if _column_cache_bytes + nbytes > _COLUMN_CACHE_MAX_BYTES:
+            # simple wholesale eviction; queries re-warm what they need
+            _COLUMN_CACHE.clear()
+            _column_cache_bytes = 0
+        _COLUMN_CACHE[key] = arr
+        _column_cache_bytes += nbytes
+
+
+class ctable:
+    """Open (mode='r'/'a') or create (mode='w') a tpucolz table directory."""
+
+    def __init__(self, rootdir, mode="r", auto_cache=True, nthreads=0,
+                 chunklen=DEFAULT_CHUNKLEN, codec_id=codec.DEFAULT_CODEC):
+        self.rootdir = rootdir
+        self.mode = mode
+        self.auto_cache = auto_cache
+        self.nthreads = nthreads
+        self._meta_path = os.path.join(rootdir, "meta.json")
+        self._attrs_path = os.path.join(rootdir, "__attrs__.json")
+        if mode == "w":
+            rm_file_or_dir(rootdir)
+            mkdir_p(os.path.join(rootdir, "cols"))
+            self.nrows = 0
+            self.chunklen = chunklen
+            self.codec_id = codec_id
+            self._columns = {}
+            self._order = []
+            self._dictionaries = {}
+            self._write_meta()
+        elif mode in ("r", "a"):
+            if not os.path.exists(self._meta_path):
+                raise IOError(f"not a tpucolz table: {rootdir}")
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            if meta.get("format") != FORMAT_NAME:
+                raise IOError(f"unknown table format in {rootdir}")
+            self.nrows = meta["nrows"]
+            self.chunklen = meta["chunklen"]
+            self.codec_id = meta["codec"]
+            self._order = meta["columns"]
+            self._columns = {}
+            for name in self._order:
+                with open(self._col_path(name, "meta.json")) as f:
+                    self._columns[name] = _ColumnMeta.from_json(json.load(f))
+            self._dictionaries = {}
+        else:
+            raise ValueError(f"bad mode {mode!r}")
+
+    # -- paths & meta ------------------------------------------------------
+    def _col_dir(self, name):
+        return os.path.join(self.rootdir, "cols", _enc_name(name))
+
+    def _col_path(self, name, fname):
+        return os.path.join(self._col_dir(name), fname)
+
+    def _write_meta(self):
+        meta = {
+            "format": FORMAT_NAME,
+            "version": FORMAT_VERSION,
+            "nrows": self.nrows,
+            "chunklen": self.chunklen,
+            "codec": self.codec_id,
+            "columns": self._order,
+        }
+        _atomic_json_dump(meta, self._meta_path)
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def names(self):
+        return list(self._order)
+
+    def __len__(self):
+        return self.nrows
+
+    def __contains__(self, name):
+        return name in self._columns
+
+    def kind(self, name):
+        return self._columns[name].kind
+
+    @property
+    def attrs(self):
+        if os.path.exists(self._attrs_path):
+            with open(self._attrs_path) as f:
+                return json.load(f)
+        return {}
+
+    def set_attrs(self, **kv):
+        attrs = self.attrs
+        attrs.update(kv)
+        _atomic_json_dump(attrs, self._attrs_path)
+
+    def dictionary(self, name):
+        """The value dictionary of a dict-encoded column (list), else None."""
+        col = self._columns[name]
+        if col.kind != KIND_DICT:
+            return None
+        if name not in self._dictionaries:
+            with open(self._col_path(name, "dictionary.json")) as f:
+                self._dictionaries[name] = json.load(f)
+        return self._dictionaries[name]
+
+    def column_raw(self, name):
+        """Physical column values as one contiguous ndarray: int32 codes for
+        dict columns, int64 ns for datetimes, the stored dtype otherwise.
+        This is the array the TPU kernels consume."""
+        col = self._columns[name]
+        data_path = self._col_path(name, "data.tpc")
+        st = os.stat(data_path) if os.path.exists(data_path) else None
+        key = (
+            os.path.realpath(self.rootdir),
+            name,
+            st.st_mtime_ns if st else 0,
+            st.st_size if st else 0,
+        )
+        if self.auto_cache:
+            hit = _cache_get(key)
+            if hit is not None:
+                return hit
+        dtype = np.dtype(col.dtype)
+        chunk_rows = sum(c["nrows"] for c in col.chunks)
+        if chunk_rows != self.nrows:
+            raise IOError(
+                f"inconsistent table {self.rootdir!r}: column {name!r} has "
+                f"{chunk_rows} rows in its chunk index but meta says {self.nrows}"
+            )
+        out = np.empty(self.nrows, dtype=dtype)
+        if col.chunks:
+            with open(data_path, "rb") as f:
+                file_buf = f.read()
+            codec.decode_column_into(
+                file_buf, col.chunks, dtype.itemsize, self.codec_id, out,
+                self.nthreads,
+            )
+        if self.auto_cache:
+            out.setflags(write=False)
+            _cache_put(key, out)
+        return out
+
+    def column(self, name):
+        """Logical column values: strings decoded from the dictionary,
+        datetimes as datetime64[ns]."""
+        col = self._columns[name]
+        raw = self.column_raw(name)
+        if col.kind == KIND_DICT:
+            dictionary = np.asarray(self.dictionary(name), dtype=object)
+            out = np.empty(len(raw), dtype=object)
+            valid = raw >= 0
+            out[valid] = dictionary[raw[valid]]
+            out[~valid] = None
+            return out
+        if col.kind == KIND_DATETIME:
+            return raw.view("datetime64[ns]")
+        return raw
+
+    def __getitem__(self, name):
+        return self.column(name)
+
+    def todataframe(self, columns=None):
+        import pandas as pd
+
+        cols = columns or self._order
+        return pd.DataFrame({c: self.column(c) for c in cols}, columns=cols)
+
+    # -- writing -----------------------------------------------------------
+    def _append_physical(self, name, values):
+        """Append physical values (already codes/int64ns/numeric) as chunks."""
+        col = self._columns[name]
+        dtype = np.dtype(col.dtype)
+        values = np.ascontiguousarray(values, dtype=dtype)
+        mkdir_p(self._col_dir(name))
+        data_path = self._col_path(name, "data.tpc")
+        offset = os.path.getsize(data_path) if os.path.exists(data_path) else 0
+        with open(data_path, "ab") as f:
+            for start in range(0, len(values), self.chunklen):
+                part = values[start:start + self.chunklen]
+                used_codec, buf = codec.encode_chunk(
+                    part.tobytes(), dtype.itemsize, self.codec_id
+                )
+                f.write(buf)
+                chunk = {
+                    "offset": offset,
+                    "csize": len(buf),
+                    "usize": part.nbytes,
+                    "nrows": len(part),
+                    "crc": zlib.crc32(buf) & 0xFFFFFFFF,
+                }
+                # A fallback writer may use a different codec than the table
+                # default (e.g. zlib instead of LZ4 without the native lib);
+                # record it per chunk so mixed tables stay readable.
+                if used_codec != self.codec_id:
+                    chunk["codec"] = used_codec
+                col.chunks.append(chunk)
+                offset += len(buf)
+        _atomic_json_dump(col.to_json(), self._col_path(name, "meta.json"))
+
+    def append_dataframe(self, df):
+        """Append a pandas DataFrame; creates columns on first append."""
+        if self.mode == "r":
+            raise IOError("table opened read-only")
+        first = not self._columns
+        if first:
+            for name in df.columns:
+                kind, phys_dtype = _classify_dtype(df[name].dtype)
+                self._columns[name] = _ColumnMeta(name, kind, phys_dtype)
+                self._order.append(name)
+                mkdir_p(self._col_dir(name))
+                if kind == KIND_DICT:
+                    self._dictionaries[name] = []
+        elif list(df.columns) != self._order:
+            raise ValueError("appended frame has different columns")
+
+        for name in self._order:
+            col = self._columns[name]
+            series = df[name]
+            if col.kind == KIND_DICT:
+                dictionary = self.dictionary(name)
+                # Vectorized ingest: factorize the batch, then remap the
+                # batch-local uniques into the persistent dictionary.
+                local_codes, local_uniques = _pd().factorize(
+                    series.to_numpy(dtype=object), use_na_sentinel=True
+                )
+                local_codes = np.asarray(local_codes)
+                lookup = {v: i for i, v in enumerate(dictionary)}
+                remap = np.empty(len(local_uniques), dtype=np.int32)
+                for j, v in enumerate(local_uniques):
+                    v = str(v)
+                    code = lookup.get(v)
+                    if code is None:
+                        code = len(dictionary)
+                        dictionary.append(v)
+                        lookup[v] = code
+                    remap[j] = code
+                codes = np.where(
+                    local_codes < 0, np.int32(-1), remap[local_codes]
+                ).astype(np.int32)
+                _atomic_json_dump(
+                    dictionary, self._col_path(name, "dictionary.json")
+                )
+                self._append_physical(name, codes)
+            elif col.kind == KIND_DATETIME:
+                self._append_physical(
+                    name, series.to_numpy(dtype="datetime64[ns]").view(np.int64)
+                )
+            else:
+                self._append_physical(name, series.to_numpy())
+        self.nrows += len(df)
+        self._write_meta()
+
+    def flush(self):
+        self._write_meta()
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def fromdataframe(cls, df, rootdir, chunklen=DEFAULT_CHUNKLEN,
+                      codec_id=codec.DEFAULT_CODEC, mode="w"):
+        ct = cls(rootdir, mode=mode, chunklen=chunklen, codec_id=codec_id)
+        ct.append_dataframe(df)
+        return ct
+
+
+def _classify_dtype(dtype):
+    """Map a pandas dtype to (kind, physical numpy dtype string)."""
+    dtype = getattr(dtype, "numpy_dtype", dtype)  # pandas extension dtypes
+    try:
+        np_dtype = np.dtype(dtype)
+    except TypeError:
+        return KIND_DICT, "<i4"
+    if np_dtype.kind == "M":
+        return KIND_DATETIME, "<i8"
+    if np_dtype.kind in "biufc":
+        return KIND_NUMERIC, np_dtype.str
+    return KIND_DICT, "<i4"
+
+
+def open_ctable(rootdir, mode="r", **kw):
+    return ctable(rootdir, mode=mode, **kw)

@@ -1,0 +1,13 @@
+#!/bin/sh
+# Build libtpucolz.so into native/build/.  Falls back from cmake+ninja to a
+# direct g++ invocation so the library builds on minimal images.
+set -e
+cd "$(dirname "$0")"
+if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
+    cmake -S . -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
+    cmake --build build >/dev/null
+else
+    mkdir -p build
+    g++ -O3 -std=c++17 -shared -fPIC tpucolz.cpp -o build/libtpucolz.so -lz -lpthread
+fi
+echo "built: $(dirname "$0")/build/libtpucolz.so"

@@ -1,0 +1,376 @@
+// tpucolz — native codec + column decoder for the bqueryd_tpu storage engine.
+//
+// TPU-native replacement for the role Blosc/bcolz play in the reference
+// (external C deps used at reference bqueryd/worker.py:291,319-322): chunked,
+// compressed column storage feeding host buffers that are then transferred to
+// TPU HBM.  Implements, from scratch:
+//
+//   * byte-shuffle filter (transpose bytes of fixed-width elements, the same
+//     trick Blosc uses to make typed arrays compressible),
+//   * an LZ4-block-format compressor/decompressor (format-compatible with the
+//     public LZ4 block spec so third-party tooling can read chunks),
+//   * a zlib codec path (system zlib) as an alternative codec id,
+//   * a multithreaded whole-column decoder (decode all chunks of a column in
+//     parallel into one contiguous destination buffer — the hot data-loading
+//     path that hides decode latency behind host->device transfers),
+//   * an int64 hash factorizer for host-side group-key dictionary building.
+//
+// Exposed as a plain C API consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// byte shuffle
+// ---------------------------------------------------------------------------
+
+void shuffle_bytes(const uint8_t* src, size_t n, size_t elem, uint8_t* dst) {
+  if (elem <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  const size_t nelems = n / elem;
+  const size_t tail = n - nelems * elem;
+  for (size_t j = 0; j < elem; ++j) {
+    const uint8_t* s = src + j;
+    uint8_t* d = dst + j * nelems;
+    for (size_t k = 0; k < nelems; ++k) {
+      d[k] = s[k * elem];
+    }
+  }
+  if (tail) std::memcpy(dst + nelems * elem, src + nelems * elem, tail);
+}
+
+void unshuffle_bytes(const uint8_t* src, size_t n, size_t elem, uint8_t* dst) {
+  if (elem <= 1) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  const size_t nelems = n / elem;
+  const size_t tail = n - nelems * elem;
+  for (size_t j = 0; j < elem; ++j) {
+    const uint8_t* s = src + j * nelems;
+    uint8_t* d = dst + j;
+    for (size_t k = 0; k < nelems; ++k) {
+      d[k * elem] = s[k];
+    }
+  }
+  if (tail) std::memcpy(dst + nelems * elem, src + nelems * elem, tail);
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 block format (https-spec compatible), greedy hash-table compressor
+// ---------------------------------------------------------------------------
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t lz4_hash(uint32_t v) { return (v * 2654435761u) >> 18; }  // 14-bit
+
+constexpr size_t kHashSize = 1u << 14;
+constexpr size_t kMinMatch = 4;
+constexpr size_t kLastLiterals = 5;   // spec: last 5 bytes are literals
+constexpr size_t kMfLimit = 12;       // spec: no match within last 12 bytes
+
+size_t lz4_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+  std::vector<int64_t> table(kHashSize, -1);
+  size_t op = 0, anchor = 0, pos = 0;
+
+  auto emit = [&](size_t lit_len, const uint8_t* lits, size_t match_len,
+                  size_t offset) -> bool {
+    // token + extended literal lengths + literals + offset + extended matchlen
+    size_t need = 1 + lit_len / 255 + 1 + lit_len + 2 + match_len / 255 + 1;
+    if (op + need > cap) return false;
+    uint8_t* token = dst + op++;
+    // literal length
+    if (lit_len >= 15) {
+      *token = 15 << 4;
+      size_t rest = lit_len - 15;
+      while (rest >= 255) {
+        dst[op++] = 255;
+        rest -= 255;
+      }
+      dst[op++] = static_cast<uint8_t>(rest);
+    } else {
+      *token = static_cast<uint8_t>(lit_len << 4);
+    }
+    std::memcpy(dst + op, lits, lit_len);
+    op += lit_len;
+    if (offset == 0) return true;  // final literals-only sequence
+    dst[op++] = static_cast<uint8_t>(offset & 0xff);
+    dst[op++] = static_cast<uint8_t>(offset >> 8);
+    size_t mlcode = match_len - kMinMatch;
+    if (mlcode >= 15) {
+      *token |= 15;
+      size_t rest = mlcode - 15;
+      while (rest >= 255) {
+        dst[op++] = 255;
+        rest -= 255;
+      }
+      dst[op++] = static_cast<uint8_t>(rest);
+    } else {
+      *token |= static_cast<uint8_t>(mlcode);
+    }
+    return true;
+  };
+
+  if (n >= kMfLimit) {
+    const size_t match_limit = n - kLastLiterals;
+    while (pos + kMfLimit <= n) {
+      uint32_t h = lz4_hash(read32(src + pos));
+      int64_t cand = table[h];
+      table[h] = static_cast<int64_t>(pos);
+      if (cand >= 0 && pos - static_cast<size_t>(cand) <= 65535 &&
+          read32(src + cand) == read32(src + pos)) {
+        size_t ml = kMinMatch;
+        while (pos + ml < match_limit && src[cand + ml] == src[pos + ml]) ++ml;
+        // Short matches barely compress but cost a whole sequence to decode;
+        // keeping them as literals makes near-incompressible byte planes
+        // decode at memcpy speed.
+        if (ml < 8) {
+          ++pos;
+          continue;
+        }
+        if (!emit(pos - anchor, src + anchor, ml, pos - cand)) return 0;
+        pos += ml;
+        anchor = pos;
+      } else {
+        ++pos;
+      }
+    }
+  }
+  // final literals
+  if (!emit(n - anchor, src + anchor, 0, 0)) return 0;
+  return op;
+}
+
+// Returns bytes written to dst (== expected usize) or 0 on malformed input.
+size_t lz4_decompress(const uint8_t* src, size_t csize, uint8_t* dst,
+                      size_t usize) {
+  size_t ip = 0, op = 0;
+  while (ip < csize) {
+    uint8_t token = src[ip++];
+    size_t lit_len = token >> 4;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= csize) return 0;
+        b = src[ip++];
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (ip + lit_len > csize || op + lit_len > usize) return 0;
+    std::memcpy(dst + op, src + ip, lit_len);
+    ip += lit_len;
+    op += lit_len;
+    if (ip >= csize) break;  // last sequence has no match part
+    if (ip + 2 > csize) return 0;
+    size_t offset = src[ip] | (static_cast<size_t>(src[ip + 1]) << 8);
+    ip += 2;
+    if (offset == 0 || offset > op) return 0;
+    size_t ml = (token & 15);
+    if (ml == 15) {
+      uint8_t b;
+      do {
+        if (ip >= csize) return 0;
+        b = src[ip++];
+        ml += b;
+      } while (b == 255);
+    }
+    ml += kMinMatch;
+    if (op + ml > usize) return 0;
+    const uint8_t* match = dst + op - offset;
+    if (offset >= ml) {
+      std::memcpy(dst + op, match, ml);
+    } else {
+      // Overlapping match = periodic pattern of period `offset`.  Seed one
+      // period then double the copied region; O(log(ml/offset)) memcpys
+      // instead of byte-at-a-time (hot for RLE-like shuffled columns).
+      uint8_t* d = dst + op;
+      size_t done = offset;  // offset < ml here
+      std::memcpy(d, match, offset);
+      while (done < ml) {
+        size_t chunk = std::min(done, ml - done);
+        std::memcpy(d + done, d, chunk);
+        done += chunk;
+      }
+    }
+    op += ml;
+  }
+  return op == usize ? op : 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// codec ids (stable, part of the on-disk format)
+enum TpcCodec : int32_t {
+  TPC_RAW = 0,
+  TPC_LZ4 = 1,
+  TPC_ZLIB = 2,
+};
+
+size_t tpc_max_csize(size_t usize) { return usize + usize / 128 + 64; }
+
+// Shuffle (if elem_size > 1) then compress with `codec`.  Returns compressed
+// size, or 0 on failure/incompressible-with-cap.
+size_t tpc_encode(const uint8_t* src, size_t usize, size_t elem_size,
+                  int32_t codec, uint8_t* dst, size_t dst_cap) {
+  if (usize == 0) return 0;
+  std::vector<uint8_t> shuffled;
+  const uint8_t* payload = src;
+  if (elem_size > 1) {
+    shuffled.resize(usize);
+    shuffle_bytes(src, usize, elem_size, shuffled.data());
+    payload = shuffled.data();
+  }
+  switch (codec) {
+    case TPC_RAW:
+      if (dst_cap < usize) return 0;
+      std::memcpy(dst, payload, usize);
+      return usize;
+    case TPC_LZ4:
+      return lz4_compress(payload, usize, dst, dst_cap);
+    case TPC_ZLIB: {
+      uLongf out_len = static_cast<uLongf>(dst_cap);
+      if (compress2(dst, &out_len, payload, static_cast<uLong>(usize), 1) != Z_OK)
+        return 0;
+      return static_cast<size_t>(out_len);
+    }
+    default:
+      return 0;
+  }
+}
+
+// Decompress and (if elem_size > 1) unshuffle.  Returns usize on success.
+size_t tpc_decode(const uint8_t* src, size_t csize, size_t usize,
+                  size_t elem_size, int32_t codec, uint8_t* dst) {
+  if (usize == 0) return 0;
+  std::vector<uint8_t> tmp;
+  uint8_t* payload = dst;
+  if (elem_size > 1) {
+    tmp.resize(usize);
+    payload = tmp.data();
+  }
+  switch (codec) {
+    case TPC_RAW:
+      if (csize != usize) return 0;
+      std::memcpy(payload, src, usize);
+      break;
+    case TPC_LZ4:
+      if (lz4_decompress(src, csize, payload, usize) != usize) return 0;
+      break;
+    case TPC_ZLIB: {
+      uLongf out_len = static_cast<uLongf>(usize);
+      if (uncompress(payload, &out_len, src, static_cast<uLong>(csize)) != Z_OK ||
+          out_len != usize)
+        return 0;
+      break;
+    }
+    default:
+      return 0;
+  }
+  if (elem_size > 1) unshuffle_bytes(payload, usize, elem_size, dst);
+  return usize;
+}
+
+// Decode a whole column: `file_buf` holds nchunks chunks back to back; chunk i
+// spans [offsets[i], offsets[i+1]) and its decoded payload is `usizes[i]`
+// bytes, written at dst + sum(usizes[:i]).  Chunks decode in parallel on up to
+// `nthreads` threads (the knob mirroring the reference's Blosc nthreads
+// setting, reference bqueryd/worker.py:40).  Returns 1 on success, 0 if any
+// chunk fails.
+int32_t tpc_decode_column(const uint8_t* file_buf, const uint64_t* offsets,
+                          const uint64_t* usizes, size_t nchunks,
+                          size_t elem_size, int32_t codec, uint8_t* dst,
+                          int32_t nthreads) {
+  if (nchunks == 0) return 1;
+  std::vector<uint64_t> dst_offsets(nchunks + 1, 0);
+  for (size_t i = 0; i < nchunks; ++i)
+    dst_offsets[i + 1] = dst_offsets[i] + usizes[i];
+
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 1;
+  if (nthreads <= 0) nthreads = hw;  // 0 = auto
+  int32_t workers = std::max(1, std::min({nthreads, hw, static_cast<int32_t>(nchunks)}));
+
+  std::atomic<size_t> next{0};
+  std::atomic<int32_t> ok{1};
+  auto run = [&]() {
+    while (true) {
+      size_t i = next.fetch_add(1);
+      if (i >= nchunks || !ok.load(std::memory_order_relaxed)) break;
+      size_t csize = offsets[i + 1] - offsets[i];
+      if (tpc_decode(file_buf + offsets[i], csize, usizes[i], elem_size, codec,
+                     dst + dst_offsets[i]) != usizes[i]) {
+        ok.store(0, std::memory_order_relaxed);
+      }
+    }
+  };
+  if (workers == 1) {
+    run();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(workers);
+    for (int32_t t = 0; t < workers; ++t) threads.emplace_back(run);
+    for (auto& t : threads) t.join();
+  }
+  return ok.load();
+}
+
+// Hash-factorize an int64 array: codes[i] = dense id of src[i] in first-seen
+// order; uniques gets the dictionary.  Returns number of uniques, or -1 if it
+// would exceed uniques_cap.  Host-side equivalent of bquery's factorization
+// (the cached factorize used at reference bqueryd/worker.py:291).
+int64_t tpc_factorize_i64(const int64_t* src, size_t n, int32_t* codes,
+                          int64_t* uniques, size_t uniques_cap) {
+  // open-addressing hash map: key -> code
+  size_t cap = 16;
+  while (cap < n * 2) cap <<= 1;
+  std::vector<int64_t> keys(cap);
+  std::vector<int32_t> vals(cap, -1);
+  std::vector<uint8_t> used(cap, 0);
+  const size_t mask = cap - 1;
+  int64_t nuniq = 0;
+  for (size_t i = 0; i < n; ++i) {
+    int64_t k = src[i];
+    uint64_t h = static_cast<uint64_t>(k) * 0x9E3779B97F4A7C15ull;
+    size_t slot = static_cast<size_t>(h >> 1) & mask;
+    while (true) {
+      if (!used[slot]) {
+        if (static_cast<size_t>(nuniq) >= uniques_cap) return -1;
+        used[slot] = 1;
+        keys[slot] = k;
+        vals[slot] = static_cast<int32_t>(nuniq);
+        uniques[nuniq] = k;
+        codes[i] = vals[slot];
+        ++nuniq;
+        break;
+      }
+      if (keys[slot] == k) {
+        codes[i] = vals[slot];
+        break;
+      }
+      slot = (slot + 1) & mask;
+    }
+  }
+  return nuniq;
+}
+
+}  // extern "C"
