@@ -1,0 +1,60 @@
+"""JAX columnar kernels (the compute role bquery's Cython kernels play in the
+reference, used at reference bqueryd/worker.py:291-323).
+
+Importing this package enables JAX 64-bit mode: the north-star acceptance
+criterion is bit-for-bit int64 aggregates, and without ``jax_enable_x64``
+int64 inputs silently degrade to int32.  Control-plane modules never import
+this package, so pure controller/downloader processes stay JAX-free.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from bqueryd_tpu.ops.factorize import (  # noqa: E402
+    factorize,
+    factorize_device,
+    pack_codes,
+    total_cardinality,
+    unpack_codes,
+)
+from bqueryd_tpu.ops.groupby import (  # noqa: E402
+    AGG_OPS,
+    MERGEABLE_OPS,
+    combine_partials,
+    finalize,
+    groupby_aggregate,
+    groupby_count_distinct,
+    groupby_sorted_count_distinct,
+    partial_tables,
+    psum_partials,
+)
+from bqueryd_tpu.ops.predicates import (  # noqa: E402
+    WHERE_OPS,
+    build_mask,
+    shard_can_match,
+    term_mask,
+    translate_value,
+)
+
+__all__ = [
+    "factorize",
+    "factorize_device",
+    "pack_codes",
+    "unpack_codes",
+    "total_cardinality",
+    "AGG_OPS",
+    "MERGEABLE_OPS",
+    "groupby_aggregate",
+    "groupby_count_distinct",
+    "groupby_sorted_count_distinct",
+    "partial_tables",
+    "combine_partials",
+    "psum_partials",
+    "finalize",
+    "WHERE_OPS",
+    "build_mask",
+    "shard_can_match",
+    "term_mask",
+    "translate_value",
+]

@@ -1,0 +1,108 @@
+"""Group-key factorization: values -> dense codes + dictionary.
+
+The TPU equivalent of bquery's factorize (the cached factorization opened with
+``auto_cache=True`` at reference bqueryd/worker.py:291).  Three layers:
+
+* :func:`factorize` — host-side, any dtype, dynamic cardinality (C++ hash map
+  for int64, NumPy otherwise).  Used at ingest and query planning.
+* :func:`factorize_device` — device-side, fixed capacity (static shapes for
+  XLA), for fully-jitted single-shard paths.
+* :func:`pack_codes` / :func:`unpack_codes` — composite multi-key codes: with
+  global per-key cardinalities ``(K1..Kn)``, a key tuple becomes one int
+  ``c1*K2*...*Kn + c2*K3*...*Kn + ... + cn``.  Tables indexed by packed code
+  are index-aligned across shards, which is what makes the
+  ``psum``-over-mesh merge legal.
+"""
+
+import numpy as np
+
+from bqueryd_tpu.storage import codec as storage_codec
+
+
+def factorize(values):
+    """Host factorize in first-seen order -> (codes int (n,), uniques).
+
+    int64/int32 go through the native hash factorizer; other dtypes through
+    NumPy.  NaNs (floats) factorize as ordinary keys (NaN != NaN is ignored:
+    all NaNs map to one group, matching pandas' dropna=False behaviour only
+    for the non-NaN part — callers on the groupby path pre-filter NaNs if the
+    reference semantics require it).
+    """
+    values = np.asarray(values)
+    if values.dtype.kind in "iu" and values.dtype.itemsize <= 8:
+        codes, uniques = storage_codec.factorize_i64(values.astype(np.int64))
+        return codes, uniques.astype(values.dtype)
+    # float / other: NumPy unique (sorted) remapped to first-seen order
+    uniques, inverse = np.unique(values, return_inverse=True)
+    first_pos = np.full(len(uniques), len(values), dtype=np.int64)
+    np.minimum.at(first_pos, inverse, np.arange(len(values)))
+    order = np.argsort(first_pos, kind="stable")
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    return remap[inverse].astype(np.int32), uniques[order]
+
+
+def factorize_device(keys, capacity, fill_value=None):
+    """Device-side fixed-capacity factorize (jit-safe, static shapes).
+
+    Returns ``(uniques[capacity], codes[n], n_uniques)``; slots past
+    ``n_uniques`` hold ``fill_value`` (default: max dtype value).  Raises at
+    trace time only for bad capacity; overflow past capacity is detectable by
+    the caller via ``n_uniques == capacity``.
+    """
+    import jax.numpy as jnp
+
+    if fill_value is None:
+        fill_value = jnp.iinfo(keys.dtype).max if jnp.issubdtype(
+            keys.dtype, jnp.integer
+        ) else jnp.inf
+    uniques, codes = jnp.unique(
+        keys, return_inverse=True, size=capacity, fill_value=fill_value
+    )
+    n_uniques = jnp.sum(uniques != fill_value).astype(jnp.int32)
+    return uniques, codes.astype(jnp.int32), n_uniques
+
+
+def pack_codes(code_arrays, cardinalities):
+    """Combine per-key dense codes into one composite code array.
+
+    Works on NumPy or JAX arrays (pure arithmetic).  ``cardinalities[i]`` must
+    bound ``code_arrays[i]`` (codes in ``[0, K_i)``); negative codes (nulls)
+    poison the whole composite to -1.
+    """
+    assert len(code_arrays) == len(cardinalities) and code_arrays
+    np_like = np if isinstance(code_arrays[0], np.ndarray) else _jnp()
+    total = code_arrays[0].astype(np_like.int64)
+    negative = code_arrays[0] < 0
+    for codes, card in zip(code_arrays[1:], cardinalities[1:]):
+        total = total * int(card) + codes.astype(np_like.int64)
+        negative = negative | (codes < 0)
+    return np_like.where(negative, np_like.int64(-1), total)
+
+
+def unpack_codes(packed, cardinalities):
+    """Inverse of :func:`pack_codes`: composite codes -> list of per-key codes.
+    Null composites (-1) unpack to -1 for every key."""
+    np_like = np if isinstance(packed, np.ndarray) else _jnp()
+    packed = packed.astype(np_like.int64)
+    null = packed < 0
+    out = []
+    rest = np_like.where(null, 0, packed)
+    for card in reversed(cardinalities[1:]):
+        out.append(np_like.where(null, np_like.int64(-1), rest % int(card)))
+        rest = rest // int(card)
+    out.append(np_like.where(null, np_like.int64(-1), rest))
+    return list(reversed(out))
+
+
+def total_cardinality(cardinalities):
+    total = 1
+    for k in cardinalities:
+        total *= int(k)
+    return total
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp

@@ -1,0 +1,143 @@
+"""where_terms: filter terms -> boolean row masks, with shard pruning.
+
+The TPU equivalent of bquery's ``where_terms`` / ``where_terms_factorization_check``
+(reference bqueryd/worker.py:296-303): a filter is a list of
+``(column, op, value)`` terms AND-ed together.  Ops: ==, !=, <, <=, >, >=,
+in, not in.
+
+Masks are computed with jnp ops so the whole predicate fuses into the
+aggregation kernel when evaluated under jit (the "masked segment_sum pushdown"
+from SURVEY.md §2.3 — no materialized row copies, unlike the reference's
+bool-array + fancy-indexing path).
+
+Value translation happens host-side against the table's dictionaries:
+
+* dict columns compare by code; a value absent from the dictionary maps to
+  code -2, which naturally yields all-false for ==/in and all-true for
+  !=/not-in (codes are always >= -1);
+* datetime columns compare as int64 nanoseconds.
+
+:func:`shard_can_match` is the cheap host-side precheck (the
+factorization-check early-out at reference bqueryd/worker.py:296-301): column
+min/max stats and dictionary membership decide whether a shard can contain any
+matching row before anything is decompressed or shipped to the device.
+"""
+
+import numpy as np
+
+WHERE_OPS = ("==", "!=", "<", "<=", ">", ">=", "in", "not in")
+
+
+def _to_ns(value):
+    import pandas as pd
+
+    return int(pd.Timestamp(value).value)
+
+
+def translate_value(table, column, value):
+    """Translate a user-facing term value into physical column space."""
+    if isinstance(value, (set, frozenset)):
+        value = list(value)  # sets accepted for in/not-in on any column kind
+    kind = table.kind(column)
+    if kind == "dict":
+        lookup = table.dict_lookup(column)
+        if isinstance(value, (list, tuple)):
+            return [lookup.get(str(v), -2) for v in value]
+        return lookup.get(str(value), -2)
+    if kind == "datetime":
+        if isinstance(value, (list, tuple)):
+            return [_to_ns(v) for v in value]
+        return _to_ns(value)
+    return value
+
+
+def term_mask(values, op, value):
+    """Boolean mask for one term over a physical value array (jnp or np)."""
+    import jax.numpy as jnp
+
+    values = jnp.asarray(values)
+    if op == "==":
+        return values == value
+    if op == "!=":
+        return values != value
+    if op == "<":
+        return values < value
+    if op == "<=":
+        return values <= value
+    if op == ">":
+        return values > value
+    if op == ">=":
+        return values >= value
+    if op == "in":
+        return jnp.isin(values, jnp.asarray(value))
+    if op == "not in":
+        return ~jnp.isin(values, jnp.asarray(value))
+    raise ValueError(f"unsupported where op {op!r}")
+
+
+def build_mask(table, where_terms_list, column_getter=None):
+    """AND together all terms into one bool mask (jnp array), or return None
+    for an empty term list (no filtering — same contract as the reference
+    passing bool_arr=None, reference bqueryd/worker.py:294-309).
+
+    ``column_getter`` overrides physical column access (the executor passes
+    device-resident columns; default reads from the table)."""
+    if not where_terms_list:
+        return None
+    get = column_getter or (lambda name: table.column_raw(name))
+    mask = None
+    for term in where_terms_list:
+        column, op, value = term
+        phys = translate_value(table, column, value)
+        m = term_mask(get(column), op, phys)
+        mask = m if mask is None else (mask & m)
+    return mask
+
+
+def shard_can_match(table, where_terms_list):
+    """Host-side pruning: False only if NO row of this shard can satisfy the
+    conjunction.  Uses column min/max stats (numeric/datetime) and dictionary
+    membership (dict columns); unknown columns/ops conservatively match."""
+    for term in where_terms_list or []:
+        column, op, value = term
+        if column not in table:
+            continue
+        kind = table.kind(column)
+        if kind == "dict":
+            phys = translate_value(table, column, value)
+            if op == "==" and phys == -2:
+                return False
+            if op == "in" and isinstance(phys, list) and all(p == -2 for p in phys):
+                return False
+            continue
+        stats = table.col_stats(column)
+        if stats is None:
+            continue
+        lo, hi = stats
+        if kind == "datetime":
+            value_phys = translate_value(table, column, value)
+        else:
+            value_phys = value
+        if op == "==" and not (
+            isinstance(value_phys, (list, tuple))
+        ) and (value_phys < lo or value_phys > hi):
+            return False
+        if op == ">" and hi <= value_phys:
+            return False
+        if op == ">=" and hi < value_phys:
+            return False
+        if op == "<" and lo >= value_phys:
+            return False
+        if op == "<=" and lo > value_phys:
+            return False
+        if op == "in" and isinstance(value_phys, (list, tuple)) and all(
+            v < lo or v > hi for v in value_phys
+        ):
+            return False
+    return True
+
+
+def mask_to_indices(mask):
+    """Materialize mask as row indices (host), for the aggregate=False
+    raw-rows path."""
+    return np.flatnonzero(np.asarray(mask))

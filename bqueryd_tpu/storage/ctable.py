@@ -73,11 +73,15 @@ def _enc_name(name):
 
 
 class _ColumnMeta:
-    def __init__(self, name, kind, dtype, chunks=None):
+    def __init__(self, name, kind, dtype, chunks=None, vmin=None, vmax=None):
         self.name = name
         self.kind = kind
         self.dtype = dtype  # physical numpy dtype string, e.g. "<i8"
         self.chunks = chunks or []
+        # column-level min/max over physical values (numeric/datetime only):
+        # powers host-side shard pruning before any decompression
+        self.vmin = vmin
+        self.vmax = vmax
 
     def to_json(self):
         return {
@@ -85,11 +89,16 @@ class _ColumnMeta:
             "kind": self.kind,
             "dtype": self.dtype,
             "chunks": self.chunks,
+            "min": self.vmin,
+            "max": self.vmax,
         }
 
     @classmethod
     def from_json(cls, d):
-        return cls(d["name"], d["kind"], d["dtype"], d["chunks"])
+        return cls(
+            d["name"], d["kind"], d["dtype"], d["chunks"],
+            d.get("min"), d.get("max"),
+        )
 
 
 # Process-wide decoded-column cache: the in-memory analogue of bquery's
@@ -151,6 +160,7 @@ class ctable:
             self._columns = {}
             self._order = []
             self._dictionaries = {}
+            self._dict_lookups = {}
             self._write_meta()
         elif mode in ("r", "a"):
             if not os.path.exists(self._meta_path):
@@ -168,6 +178,7 @@ class ctable:
                 with open(self._col_path(name, "meta.json")) as f:
                     self._columns[name] = _ColumnMeta.from_json(json.load(f))
             self._dictionaries = {}
+            self._dict_lookups = {}
         else:
             raise ValueError(f"bad mode {mode!r}")
 
@@ -215,6 +226,14 @@ class ctable:
         attrs.update(kv)
         _atomic_json_dump(attrs, self._attrs_path)
 
+    def col_stats(self, name):
+        """(min, max) over the column's physical values, or None if unknown
+        (dict columns, empty columns, legacy tables)."""
+        col = self._columns[name]
+        if col.vmin is None:
+            return None
+        return (col.vmin, col.vmax)
+
     def dictionary(self, name):
         """The value dictionary of a dict-encoded column (list), else None."""
         col = self._columns[name]
@@ -224,6 +243,18 @@ class ctable:
             with open(self._col_path(name, "dictionary.json")) as f:
                 self._dictionaries[name] = json.load(f)
         return self._dictionaries[name]
+
+    def dict_lookup(self, name):
+        """Memoized {value: code} mapping for a dict column (predicate
+        translation hot path — rebuilt only when the dictionary grows)."""
+        dictionary = self.dictionary(name)
+        if dictionary is None:
+            return None
+        cached = self._dict_lookups.get(name)
+        if cached is None or len(cached) != len(dictionary):
+            cached = {v: i for i, v in enumerate(dictionary)}
+            self._dict_lookups[name] = cached
+        return cached
 
     def column_raw(self, name):
         """Physical column values as one contiguous ndarray: int32 codes for
@@ -293,6 +324,23 @@ class ctable:
         col = self._columns[name]
         dtype = np.dtype(col.dtype)
         values = np.ascontiguousarray(values, dtype=dtype)
+        if (
+            col.kind in (KIND_NUMERIC, KIND_DATETIME)
+            and dtype.kind in "iuf"  # no stats for complex/bool storage
+            and len(values)
+        ):
+            stat_values = values
+            if col.kind == KIND_DATETIME:
+                # NaT is INT64_MIN in the ns view; it must not poison vmin
+                stat_values = values[values != np.iinfo(np.int64).min]
+            if len(stat_values):
+                with np.errstate(all="ignore"):
+                    lo = np.nanmin(stat_values)
+                    hi = np.nanmax(stat_values)
+                if not (isinstance(lo, np.floating) and np.isnan(lo)):
+                    lo, hi = lo.item(), hi.item()
+                    col.vmin = lo if col.vmin is None else min(col.vmin, lo)
+                    col.vmax = hi if col.vmax is None else max(col.vmax, hi)
         mkdir_p(self._col_dir(name))
         data_path = self._col_path(name, "data.tpc")
         offset = os.path.getsize(data_path) if os.path.exists(data_path) else 0

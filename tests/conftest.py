@@ -7,12 +7,30 @@ import time rather than in a fixture.  Real-TPU runs happen in ``bench.py``.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault: the machine env pins JAX_PLATFORMS=axon, the real-TPU
+# tunnel) the CPU platform with 8 virtual devices for hermetic sharding tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+def pytest_configure(config):
+    # The machine's sitecustomize imports jax at interpreter boot (TPU-tunnel
+    # registration), so jax latched JAX_PLATFORMS=axon from the env before this
+    # conftest could touch it: override the live config too, and drop the
+    # tunnel backend factory so CPU-only tests can never touch (or hang on)
+    # the tunnel.
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
 
 import time
 

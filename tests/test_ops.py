@@ -1,0 +1,353 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+# one namespace for all kernel entry points (module names are shadowed by the
+# function re-exports in bqueryd_tpu.ops, so don't import submodules directly)
+from bqueryd_tpu import ops as fz
+from bqueryd_tpu import ops as gb
+from bqueryd_tpu import ops as pred
+from bqueryd_tpu.storage import ctable
+
+
+def taxi_like_df(n=20_000, seed=1):
+    rng = np.random.default_rng(seed)
+    fare = rng.gamma(2.0, 7.0, n)
+    fare[rng.random(n) < 0.01] = np.nan  # exercise NaN skipping
+    return pd.DataFrame(
+        {
+            "VendorID": rng.integers(1, 3, n).astype(np.int64),
+            "passenger_count": rng.integers(0, 7, n).astype(np.int64),
+            "payment_type": rng.integers(1, 5, n).astype(np.int64),
+            "trip_distance": rng.exponential(3.0, n),
+            "fare_amount": fare,
+            "total_amount": rng.gamma(2.5, 8.0, n),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# factorize
+# ---------------------------------------------------------------------------
+
+def test_factorize_int_matches_pandas():
+    values = np.array([5, 2, 5, 9, 2, 5, -3], dtype=np.int64)
+    codes, uniques = fz.factorize(values)
+    pd_codes, pd_uniques = pd.factorize(values)
+    np.testing.assert_array_equal(codes, pd_codes)
+    np.testing.assert_array_equal(uniques, pd_uniques)
+
+
+def test_factorize_float():
+    values = np.array([1.5, 0.5, 1.5, 2.5])
+    codes, uniques = fz.factorize(values)
+    np.testing.assert_array_equal(uniques[codes], values)
+    assert uniques.tolist() == [1.5, 0.5, 2.5]
+
+
+def test_factorize_device_fixed_capacity():
+    import jax.numpy as jnp
+
+    keys = jnp.array([7, 3, 7, 7, 1], dtype=jnp.int64)
+    uniques, codes, n = fz.factorize_device(keys, capacity=8)
+    assert int(n) == 3
+    np.testing.assert_array_equal(np.asarray(uniques)[codes], np.asarray(keys))
+
+
+def test_pack_unpack_codes_roundtrip():
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 5, 100).astype(np.int64)
+    b = rng.integers(0, 7, 100).astype(np.int64)
+    c = rng.integers(0, 3, 100).astype(np.int64)
+    packed = fz.pack_codes([a, b, c], [5, 7, 3])
+    ua, ub, uc = fz.unpack_codes(packed, [5, 7, 3])
+    np.testing.assert_array_equal(ua, a)
+    np.testing.assert_array_equal(ub, b)
+    np.testing.assert_array_equal(uc, c)
+
+
+def test_pack_codes_null_poisons():
+    packed = fz.pack_codes(
+        [np.array([0, -1, 2]), np.array([1, 1, -1])], [3, 2]
+    )
+    assert packed.tolist() == [1, -1, -1]
+
+
+# ---------------------------------------------------------------------------
+# groupby kernels vs pandas
+# ---------------------------------------------------------------------------
+
+def run_groupby(df, key, measure, op, mask=None):
+    codes, uniques = fz.factorize(df[key].to_numpy())
+    tables, rows = gb.groupby_aggregate(
+        codes,
+        (df[measure].to_numpy(),),
+        (op,),
+        n_groups=len(uniques),
+        mask=None if mask is None else np.asarray(mask),
+    )
+    return uniques, np.asarray(tables[0]), np.asarray(rows)
+
+
+@pytest.mark.parametrize("op,pandas_op", [
+    ("sum", "sum"), ("mean", "mean"), ("count", "count"),
+    ("min", "min"), ("max", "max"),
+])
+def test_groupby_matches_pandas(op, pandas_op):
+    df = taxi_like_df()
+    uniques, got, _rows = run_groupby(df, "payment_type", "fare_amount", op)
+    expected = getattr(df.groupby("payment_type")["fare_amount"], pandas_op)()
+    got_series = pd.Series(got, index=uniques).sort_index()
+    pd.testing.assert_series_equal(
+        got_series, expected.sort_index(), check_names=False,
+        check_index_type=False, check_dtype=False,
+    )
+
+
+def test_groupby_int64_sum_bit_exact():
+    """North-star criterion: int64 sums agree bit-for-bit with a CPU
+    reference (numpy bincount accumulation)."""
+    rng = np.random.default_rng(11)
+    n = 100_000
+    keys = rng.integers(0, 50, n).astype(np.int64)
+    # large values to exercise 64-bit range (sums far beyond int32)
+    values = rng.integers(-(2**40), 2**40, n).astype(np.int64)
+    codes, uniques = fz.factorize(keys)
+    tables, _ = gb.groupby_aggregate(codes, (values,), ("sum",), len(uniques))
+    got = np.asarray(tables[0])
+    expected = np.zeros(len(uniques), dtype=np.int64)
+    np.add.at(expected, codes, values)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_groupby_count_na():
+    df = taxi_like_df()
+    uniques, got, _ = run_groupby(df, "payment_type", "fare_amount", "count_na")
+    expected = df["fare_amount"].isna().groupby(df["payment_type"]).sum()
+    got_series = pd.Series(got, index=uniques).sort_index()
+    pd.testing.assert_series_equal(
+        got_series, expected.sort_index(), check_names=False,
+        check_index_type=False, check_dtype=False,
+    )
+
+
+def test_groupby_multikey_via_packed_codes():
+    df = taxi_like_df()
+    c1, u1 = fz.factorize(df["VendorID"].to_numpy())
+    c2, u2 = fz.factorize(df["payment_type"].to_numpy())
+    packed = fz.pack_codes([c1, c2], [len(u1), len(u2)])
+    dense, combos = fz.factorize(packed)
+    tables, rows = gb.groupby_aggregate(
+        dense, (df["total_amount"].to_numpy(),), ("sum",), len(combos)
+    )
+    got = {}
+    for combo, value in zip(combos, np.asarray(tables[0])):
+        i1, i2 = divmod(int(combo), len(u2))
+        got[(u1[i1], u2[i2])] = value
+    expected = df.groupby(["VendorID", "payment_type"])["total_amount"].sum()
+    assert set(got) == set(expected.index)
+    for key, value in expected.items():
+        assert got[key] == pytest.approx(value)
+
+
+def test_groupby_mask_pushdown_matches_filtered_pandas():
+    df = taxi_like_df()
+    mask = (df["trip_distance"] > 5.0).to_numpy()
+    uniques, got, rows = run_groupby(df, "payment_type", "total_amount", "sum", mask)
+    expected = df[mask].groupby("payment_type")["total_amount"].sum()
+    got_series = pd.Series(got, index=uniques)[rows > 0].sort_index()
+    pd.testing.assert_series_equal(
+        got_series, expected.sort_index(), check_names=False,
+        check_index_type=False, check_dtype=False,
+    )
+
+
+def test_groupby_negative_codes_dropped():
+    codes = np.array([0, -1, 1, 0], dtype=np.int32)
+    values = np.array([10.0, 99.0, 20.0, 30.0])
+    tables, rows = gb.groupby_aggregate(codes, (values,), ("sum",), 2)
+    assert np.asarray(tables[0]).tolist() == [40.0, 20.0]
+    assert np.asarray(rows).tolist() == [2, 1]
+
+
+def test_partials_merge_equals_full():
+    """Merging per-shard partials must equal the unsharded result — the
+    invariant the psum merge relies on (shard-vs-full equivalence, reference
+    tests/test_simple_rpc.py:175-190)."""
+    df = taxi_like_df(n=9_000)
+    shards = [df.iloc[i::3] for i in range(3)]
+    key_uniques = np.unique(df["payment_type"].to_numpy())
+    n_groups = len(key_uniques)
+    ops = ("sum", "mean", "count", "min", "max")
+
+    def shard_partials(part):
+        codes = np.searchsorted(key_uniques, part["payment_type"].to_numpy())
+        measures = tuple(part["fare_amount"].to_numpy() for _ in ops)
+        return gb.partial_tables(
+            codes.astype(np.int32), measures, ops, n_groups
+        )
+
+    merged = shard_partials(shards[0])
+    for s in shards[1:]:
+        merged = gb.combine_partials(merged, shard_partials(s))
+    merged_tables = gb.finalize(merged, ops)
+
+    full = shard_partials(df)
+    full_tables = gb.finalize(full, ops)
+    for m, f in zip(merged_tables, full_tables):
+        np.testing.assert_allclose(np.asarray(m), np.asarray(f), rtol=1e-12)
+
+
+def test_weighted_mean_not_sum_of_means():
+    """The reference merges shard means by summing them (reference
+    bqueryd/rpc.py:171); the partial representation must produce the true
+    weighted mean instead."""
+    a = pd.DataFrame({"k": [1, 1, 1], "v": [1.0, 1.0, 1.0]})   # mean 1, n=3
+    b = pd.DataFrame({"k": [1], "v": [5.0]})                    # mean 5, n=1
+    ops = ("mean",)
+
+    def partials(df):
+        codes = np.zeros(len(df), dtype=np.int32)
+        return gb.partial_tables(codes, (df["v"].to_numpy(),), ops, 1)
+
+    merged = gb.combine_partials(partials(a), partials(b))
+    mean = float(gb.finalize(merged, ops)[0][0])
+    assert mean == pytest.approx(2.0)      # (3*1 + 5)/4, NOT 1+5=6
+
+
+def test_count_distinct_matches_pandas():
+    df = taxi_like_df()
+    gcodes, guniques = fz.factorize(df["payment_type"].to_numpy())
+    vcodes, vuniques = fz.factorize(df["passenger_count"].to_numpy())
+    got = gb.groupby_count_distinct(
+        gcodes, vcodes, n_groups=len(guniques), n_values=len(vuniques)
+    )
+    expected = df.groupby("payment_type")["passenger_count"].nunique()
+    got_series = pd.Series(np.asarray(got), index=guniques).sort_index()
+    pd.testing.assert_series_equal(
+        got_series, expected.sort_index(), check_names=False,
+        check_index_type=False, check_dtype=False,
+    )
+
+
+def test_sorted_count_distinct_on_sorted_data():
+    df = taxi_like_df().sort_values(["payment_type", "passenger_count"])
+    gcodes, guniques = fz.factorize(df["payment_type"].to_numpy())
+    got = gb.groupby_sorted_count_distinct(
+        gcodes, df["passenger_count"].to_numpy(), n_groups=len(guniques)
+    )
+    expected = df.groupby("payment_type")["passenger_count"].nunique()
+    got_series = pd.Series(np.asarray(got), index=guniques).sort_index()
+    pd.testing.assert_series_equal(
+        got_series, expected.sort_index(), check_names=False,
+        check_index_type=False, check_dtype=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# predicates
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def taxi_table(tmp_path):
+    df = taxi_like_df(n=5_000)
+    df["store_and_fwd_flag"] = np.where(df["VendorID"] == 1, "Y", "N")
+    root = str(tmp_path / "taxi.bcolz")
+    ctable.fromdataframe(df, root)
+    return df, ctable(root, mode="r")
+
+
+@pytest.mark.parametrize("term,pandas_expr", [
+    (("trip_distance", ">", 5.0), lambda d: d.trip_distance > 5.0),
+    (("trip_distance", "<=", 1.0), lambda d: d.trip_distance <= 1.0),
+    (("payment_type", "==", 2), lambda d: d.payment_type == 2),
+    (("payment_type", "!=", 2), lambda d: d.payment_type != 2),
+    (("payment_type", "in", [1, 3]), lambda d: d.payment_type.isin([1, 3])),
+    (("payment_type", "not in", [1, 3]), lambda d: ~d.payment_type.isin([1, 3])),
+    (("store_and_fwd_flag", "==", "Y"), lambda d: d.store_and_fwd_flag == "Y"),
+])
+def test_term_masks_match_pandas(taxi_table, term, pandas_expr):
+    df, table = taxi_table
+    mask = pred.build_mask(table, [term])
+    np.testing.assert_array_equal(np.asarray(mask), pandas_expr(df).to_numpy())
+
+
+def test_multi_term_conjunction(taxi_table):
+    df, table = taxi_table
+    mask = pred.build_mask(
+        table, [("trip_distance", ">", 2.0), ("payment_type", "==", 1)]
+    )
+    expected = (df.trip_distance > 2.0) & (df.payment_type == 1)
+    np.testing.assert_array_equal(np.asarray(mask), expected.to_numpy())
+
+
+def test_unknown_dict_value_semantics(taxi_table):
+    _df, table = taxi_table
+    assert not np.asarray(
+        pred.build_mask(table, [("store_and_fwd_flag", "==", "MISSING")])
+    ).any()
+    assert np.asarray(
+        pred.build_mask(table, [("store_and_fwd_flag", "!=", "MISSING")])
+    ).all()
+
+
+def test_empty_terms_is_none(taxi_table):
+    _df, table = taxi_table
+    assert pred.build_mask(table, []) is None
+
+
+def test_shard_can_match_pruning(taxi_table):
+    _df, table = taxi_table
+    # trip_distance >= 0 always; a > max(col) filter can never match
+    hi = table.col_stats("trip_distance")[1]
+    assert not pred.shard_can_match(table, [("trip_distance", ">", hi + 1)])
+    assert pred.shard_can_match(table, [("trip_distance", ">", hi - 1)])
+    assert not pred.shard_can_match(table, [("payment_type", "==", 99)])
+    assert not pred.shard_can_match(
+        table, [("store_and_fwd_flag", "==", "MISSING")]
+    )
+    assert pred.shard_can_match(table, [("store_and_fwd_flag", "==", "Y")])
+
+
+def test_sorted_count_distinct_masked_run_leader():
+    """A mask dropping the first row of a run must not hide the run
+    (regression: boundary detection vs previous *valid* row)."""
+    codes = np.array([0, 0], dtype=np.int32)
+    values = np.array([5.0, 5.0])
+    got = gb.groupby_sorted_count_distinct(
+        codes, values, n_groups=1, mask=np.array([False, True])
+    )
+    assert int(got[0]) == 1
+
+
+def test_unpack_codes_preserves_null():
+    out = fz.unpack_codes(np.array([-1, 3]), [3, 2])
+    assert out[0].tolist() == [-1, 1]
+    assert out[1].tolist() == [-1, 1]
+
+
+def test_in_with_set_on_numeric_column(tmp_path):
+    df = pd.DataFrame({"payment_type": np.array([1, 2, 3, 4], dtype=np.int64)})
+    root = str(tmp_path / "t.bcolz")
+    ctable.fromdataframe(df, root)
+    table = ctable(root, mode="r")
+    mask = pred.build_mask(table, [("payment_type", "in", {1, 3})])
+    assert np.asarray(mask).tolist() == [True, False, True, False]
+
+
+def test_min_preserves_true_negative_infinity():
+    codes = np.array([0, 0], dtype=np.int32)
+    values = np.array([-np.inf, 1.0])
+    (table,), rows = gb.groupby_aggregate(codes, (values,), ("min",), 1)
+    assert np.isneginf(np.asarray(table)[0])
+
+
+def test_nat_does_not_poison_datetime_stats(tmp_path):
+    ts = pd.Series(pd.to_datetime(["2016-01-02", None, "2016-01-05"]))
+    root = str(tmp_path / "t.bcolz")
+    ctable.fromdataframe(pd.DataFrame({"t": ts}), root)
+    table = ctable(root, mode="r")
+    lo, hi = table.col_stats("t")
+    assert lo == pd.Timestamp("2016-01-02").value
+    assert hi == pd.Timestamp("2016-01-05").value
