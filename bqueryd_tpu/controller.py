@@ -1,0 +1,661 @@
+"""ControllerNode: the broker — discovery, scheduling, fan-out, sink merge.
+
+Re-design of the reference controller (reference bqueryd/controller.py:28-578)
+with the same observable surface (verbs, WRM registration cycle, dead-worker
+cull, affinity queues, peer gossip) and three deliberate changes:
+
+* **results are small**: workers return partial aggregation tables (or
+  filtered rows), already merged across their local device mesh, so the sink
+  keeps payloads in memory instead of spooling tar files to disk (reference
+  bqueryd/controller.py:174-211);
+* **dispatch is tracked**: every in-flight shard has a timestamp and is
+  re-queued (bounded retries) if its worker dies or times out — the TODO the
+  reference never implemented (reference bqueryd/controller.py:265);
+* **the controller never imports JAX or pandas** — merging partial tables is
+  the client's job (value-keyed NumPy merge), keeping the broker cheap.
+
+Wire framing on the single ROUTER socket (identity ``tcp://ip:port``, random
+port in 14300-14399, reference bqueryd/controller.py:33-42):
+
+* 3 frames with empty middle  = RPC request from a REQ client
+* 3 frames, non-empty middle  = worker reply carrying a binary result frame
+* 2 frames                    = worker/peer control message
+"""
+
+import binascii
+import json
+import os
+import pickle
+import random
+import time
+
+import zmq
+
+import bqueryd_tpu
+from bqueryd_tpu import messages
+from bqueryd_tpu.coordination import coordination_store
+from bqueryd_tpu.messages import (
+    BusyMessage,
+    CalcMessage,
+    DoneMessage,
+    ErrorMessage,
+    Message,
+    RPCMessage,
+    StopMessage,
+    TicketDoneMessage,
+    WorkerRegisterMessage,
+    msg_factory,
+)
+from bqueryd_tpu.utils.net import bind_to_random_port, get_my_ip
+
+POLLING_TIMEOUT = 0.5        # seconds
+DEAD_WORKER_TIMEOUT = 60.0   # cull workers silent longer than this
+HEARTBEAT_INTERVAL = 2.0     # store re-registration + peer sync period
+DISPATCH_TIMEOUT = 120.0     # re-queue in-flight work after this
+MAX_DISPATCH_RETRIES = 2
+RUNFILE_DIR = os.environ.get("BQUERYD_TPU_RUNFILE_DIR", "/srv")
+
+CONTROLLER_VERBS = (
+    "ping", "loglevel", "info", "kill", "killworkers", "killall",
+    "download", "readfile", "execute_code", "sleep", "groupby",
+)
+
+
+class ControllerNode:
+    def __init__(
+        self,
+        coordination_url=None,
+        redis_url=None,
+        loglevel=None,
+        runfile_dir=RUNFILE_DIR,
+        heartbeat_interval=HEARTBEAT_INTERVAL,
+        dead_worker_timeout=DEAD_WORKER_TIMEOUT,
+        dispatch_timeout=DISPATCH_TIMEOUT,
+        port_range=(14300, 14400),
+    ):
+        import logging
+
+        bqueryd_tpu.configure_logging(loglevel or logging.INFO)
+        self.store = coordination_store(
+            coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
+        )
+        self.heartbeat_interval = heartbeat_interval
+        self.dead_worker_timeout = dead_worker_timeout
+        self.dispatch_timeout = dispatch_timeout
+
+        self.context = zmq.Context.instance()
+        self.socket = self.context.socket(zmq.ROUTER)
+        self.socket.setsockopt(zmq.ROUTER_MANDATORY, 1)
+        self.socket.setsockopt(zmq.SNDTIMEO, 1000)
+        self.socket.setsockopt(zmq.LINGER, 500)
+        ip = get_my_ip()
+        self.address = bind_to_random_port(
+            self.socket, f"tcp://{ip}", port_range[0], port_range[1]
+        )
+        self.logger = bqueryd_tpu.logger.getChild(f"controller.{self.address}")
+        self.node_name = __import__("socket").gethostname()
+
+        self.poller = zmq.Poller()
+        self.poller.register(self.socket, zmq.POLLIN)
+
+        # state
+        self.worker_map = {}          # worker_id -> wrm info (+ last_seen/busy)
+        self.files_map = {}           # filename -> set(worker_id)
+        self.others = {}              # peer address -> info
+        self.worker_out_messages = {None: []}  # affinity -> [msg, ...]
+        self._affinity_rr = 0
+        self.rpc_segments = {}        # parent_token -> fan-out bookkeeping
+        self.inflight = {}            # shard token -> dict(worker, sent_at, msg, parent)
+        self.msg_count_in = 0
+        self.start_time = time.time()
+        self.running = False
+        self.last_heartbeat = 0.0
+
+        self.runfile_dir = runfile_dir
+        self._write_runfiles()
+
+    # -- runfiles ----------------------------------------------------------
+    def _write_runfiles(self):
+        self._runfiles = []
+        try:
+            for suffix, content in (
+                ("address", self.address),
+                ("pid", str(os.getpid())),
+            ):
+                path = os.path.join(
+                    self.runfile_dir, f"bqueryd_tpu_controller.{suffix}"
+                )
+                with open(path, "w") as f:
+                    f.write(content)
+                self._runfiles.append(path)
+        except OSError:
+            self.logger.debug("runfile dir %s not writable", self.runfile_dir)
+
+    def _remove_runfiles(self):
+        for path in self._runfiles:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- main loop ---------------------------------------------------------
+    def go(self):
+        self.running = True
+        self.logger.info("controller %s running", self.address)
+        try:
+            while self.running:
+                try:
+                    self.heartbeat()
+                    self.free_dead_workers()
+                    self.retry_stale_dispatches()
+                    events = dict(self.poller.poll(int(POLLING_TIMEOUT * 1000)))
+                    if self.socket in events:
+                        # drain everything available this tick
+                        while True:
+                            try:
+                                frames = self.socket.recv_multipart(zmq.NOBLOCK)
+                            except zmq.Again:
+                                break
+                            self.handle_in(frames)
+                    self.dispatch_pending()
+                except Exception:
+                    self.logger.exception("error in controller loop")
+        finally:
+            self.stop()
+
+    def stop(self):
+        try:
+            self.store.srem(bqueryd_tpu.REDIS_SET_KEY, self.address)
+        except Exception:
+            pass
+        self._remove_runfiles()
+        self.socket.close()
+        self.logger.info("controller %s stopped", self.address)
+
+    # -- membership --------------------------------------------------------
+    def heartbeat(self):
+        now = time.time()
+        if now - self.last_heartbeat < self.heartbeat_interval:
+            return
+        self.last_heartbeat = now
+        self.store.sadd(bqueryd_tpu.REDIS_SET_KEY, self.address)
+        current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
+        for addr in current:
+            if addr == self.address or addr in self.others:
+                continue
+            self.logger.debug("connecting to peer %s", addr)
+            self.socket.connect(addr)
+            self.others[addr] = {"last_seen": 0.0}
+        for addr in list(self.others):
+            if addr not in current:
+                self.others.pop(addr, None)
+                continue
+            gossip = Message({"payload": "peer_info"})
+            gossip["from"] = self.address
+            gossip.add_as_binary("info", self.get_info(include_peers=False))
+            try:
+                self.socket.send_multipart(
+                    [addr.encode(), gossip.to_json().encode()]
+                )
+            except zmq.ZMQError:
+                # unreachable peer: drop it from the registry so clients and
+                # workers stop trying it (reference bqueryd/controller.py:94-97)
+                self.logger.warning("peer %s unreachable, removing", addr)
+                self.store.srem(bqueryd_tpu.REDIS_SET_KEY, addr)
+                self.others.pop(addr, None)
+
+    def free_dead_workers(self):
+        now = time.time()
+        for worker_id, info in list(self.worker_map.items()):
+            if now - info.get("last_seen", now) > self.dead_worker_timeout:
+                self.logger.warning("culling dead worker %s", worker_id)
+                self.remove_worker(worker_id)
+
+    def remove_worker(self, worker_id):
+        self.worker_map.pop(worker_id, None)
+        for filename in list(self.files_map):
+            self.files_map[filename].discard(worker_id)
+            if not self.files_map[filename]:
+                del self.files_map[filename]
+        # re-queue anything in flight on that worker
+        for token, entry in list(self.inflight.items()):
+            if entry["worker"] == worker_id:
+                self.inflight.pop(token)
+                self._requeue(entry)
+
+    # -- scheduling --------------------------------------------------------
+    def find_free_worker(self, needs_local=False, filename=None):
+        """Random choice among free calc workers, constrained to workers
+        advertising ``filename`` and optionally to this controller's host
+        (reference bqueryd/controller.py:113-144)."""
+        candidates = []
+        for worker_id, info in self.worker_map.items():
+            if info.get("workertype") != "calc" or info.get("busy"):
+                continue
+            if filename and worker_id not in self.files_map.get(filename, ()):
+                continue
+            if needs_local and info.get("node") != self.node_name:
+                continue
+            candidates.append(worker_id)
+        return random.choice(candidates) if candidates else None
+
+    def dispatch_pending(self):
+        """Drain affinity queues round-robin, one message per queue per tick
+        (reference bqueryd/controller.py:223-268)."""
+        affinities = sorted(self.worker_out_messages, key=lambda a: (a is None, a))
+        if not affinities:
+            return
+        for offset in range(len(affinities)):
+            affinity = affinities[
+                (self._affinity_rr + offset) % len(affinities)
+            ]
+            queue = self.worker_out_messages.get(affinity, [])
+            if not queue:
+                if affinity is not None:
+                    self.worker_out_messages.pop(affinity, None)
+                continue
+            msg = queue[0]
+            worker_id = msg.get("worker_id") or self.find_free_worker(
+                needs_local=msg.get("needs_local", False),
+                filename=msg.get("filename"),
+            )
+            if worker_id is None:
+                continue  # retry next tick
+            queue.pop(0)
+            self._send_to_worker(worker_id, msg)
+        self._affinity_rr += 1
+
+    def _send_to_worker(self, worker_id, msg):
+        try:
+            self.socket.send_multipart(
+                [worker_id.encode(), msg.to_json().encode()]
+            )
+        except zmq.ZMQError as exc:
+            self.logger.warning("send to worker %s failed: %s", worker_id, exc)
+            self.remove_worker(worker_id)
+            self._requeue({"msg": msg, "retries": msg.get("_retries", 0),
+                           "parent": msg.get("parent_token")})
+            return
+        if worker_id in self.worker_map:
+            self.worker_map[worker_id]["busy"] = True
+        token = msg.get("token")
+        if token:
+            self.inflight[token] = {
+                "worker": worker_id,
+                "sent_at": time.time(),
+                "msg": msg,
+                "parent": msg.get("parent_token"),
+                "retries": msg.get("_retries", 0),
+            }
+
+    def retry_stale_dispatches(self):
+        now = time.time()
+        for token, entry in list(self.inflight.items()):
+            if now - entry["sent_at"] > self.dispatch_timeout:
+                self.logger.warning(
+                    "dispatch %s to %s timed out", token, entry["worker"]
+                )
+                self.inflight.pop(token)
+                self._requeue(entry)
+
+    def _requeue(self, entry):
+        msg = entry["msg"]
+        retries = entry.get("retries", 0)
+        parent = entry.get("parent") or msg.get("parent_token")
+        if retries >= MAX_DISPATCH_RETRIES:
+            self.abort_parent(
+                parent,
+                f"shard {msg.get('filename')} failed after "
+                f"{retries} retries (worker lost or timed out)",
+            )
+            return
+        msg["_retries"] = retries + 1
+        affinity = msg.get("affinity")
+        self.worker_out_messages.setdefault(affinity, []).append(msg)
+
+    # -- inbound demux -----------------------------------------------------
+    def handle_in(self, frames):
+        self.msg_count_in += 1
+        if len(frames) == 3 and frames[1] == b"":
+            self.handle_rpc(frames[0], frames[2])
+            return
+        if len(frames) == 3:
+            try:
+                msg = msg_factory(frames[1])
+            except messages.MalformedMessage:
+                self.logger.warning("malformed worker reply dropped")
+                return
+            msg["data"] = frames[2]
+            self.handle_worker(frames[0], msg)
+            return
+        if len(frames) == 2:
+            try:
+                msg = msg_factory(frames[1])
+            except messages.MalformedMessage:
+                self.logger.warning("malformed message dropped")
+                return
+            if msg.get("payload") == "peer_info":
+                self.handle_peer(msg)
+            else:
+                self.handle_worker(frames[0], msg)
+            return
+        self.logger.warning("dropping %d-frame message", len(frames))
+
+    # -- worker messages ---------------------------------------------------
+    def handle_worker(self, sender, msg):
+        worker_id = (
+            msg.get("worker_id")
+            or (sender.decode() if isinstance(sender, bytes) else sender)
+        )
+        now = time.time()
+        if msg.isa(WorkerRegisterMessage):
+            info = dict(msg)
+            info["last_seen"] = now
+            info["busy"] = self.worker_map.get(worker_id, {}).get("busy", False)
+            self.worker_map[worker_id] = info
+            current_files = set(info.get("data_files", []))
+            for filename in current_files:
+                self.files_map.setdefault(filename, set()).add(worker_id)
+            for filename in list(self.files_map):
+                if filename not in current_files:
+                    self.files_map[filename].discard(worker_id)
+                    if not self.files_map[filename]:
+                        del self.files_map[filename]
+            return
+        if worker_id not in self.worker_map:
+            # a message from a culled worker: ask it to re-register by just
+            # recording minimal liveness (reference bqueryd/controller.py:315-318)
+            self.worker_map[worker_id] = {
+                "worker_id": worker_id, "last_seen": now, "busy": False,
+                "workertype": "unknown",
+            }
+        else:
+            self.worker_map[worker_id]["last_seen"] = now
+
+        if msg.isa(BusyMessage):
+            self.worker_map[worker_id]["busy"] = True
+            return
+        if msg.isa(DoneMessage):
+            self.worker_map[worker_id]["busy"] = False
+            return
+        if msg.isa(StopMessage):
+            self.remove_worker(worker_id)
+            return
+        if msg.isa(TicketDoneMessage):
+            self.release_ticket_waiters(msg.get("ticket"))
+            return
+        token = msg.get("token")
+        if token:
+            self.worker_map[worker_id]["busy"] = False
+            self.inflight.pop(token, None)
+            self.process_worker_result(msg)
+
+    # -- results sink ------------------------------------------------------
+    def process_worker_result(self, msg):
+        parent = msg.get("parent_token")
+        if parent is None:
+            # single-segment RPC (execute_code, sleep, readfile): a binary
+            # data frame is folded into the JSON reply as base64
+            data = msg.pop("data", None)
+            if data is not None:
+                msg.add_as_binary("result", data)
+            self.reply_rpc_message(msg.get("token"), msg)
+            return
+        segment = self.rpc_segments.get(parent)
+        if segment is None:
+            self.logger.warning("orphaned result for parent %s dropped", parent)
+            return
+        if msg.isa(ErrorMessage):
+            self.abort_parent(parent, msg.get("payload"))
+            return
+        filename = msg.get("filename")
+        segment["results"][filename] = msg.get("data") or b""
+        segment["timings"][filename] = msg.get("phase_timings")
+        if len(segment["results"]) == len(segment["filenames"]):
+            self.rpc_segments.pop(parent)
+            payloads = [segment["results"][f] for f in segment["filenames"]]
+            reply = pickle.dumps(
+                {"ok": True, "payloads": payloads, "timings": segment["timings"]},
+                protocol=4,
+            )
+            self.reply_rpc_raw(segment["client_token"], reply)
+
+    def abort_parent(self, parent, error_text):
+        segment = self.rpc_segments.pop(parent, None)
+        if segment is None:
+            return
+        # drop queued siblings of the aborted query
+        for queue in self.worker_out_messages.values():
+            queue[:] = [m for m in queue if m.get("parent_token") != parent]
+        reply = pickle.dumps({"ok": False, "error": str(error_text)}, protocol=4)
+        self.reply_rpc_raw(segment["client_token"], reply)
+
+    def reply_rpc_raw(self, client_token, payload_bytes):
+        client = binascii.unhexlify(client_token)
+        try:
+            self.socket.send_multipart([client, b"", payload_bytes])
+        except zmq.ZMQError:
+            self.logger.exception("could not reply to client %r", client_token)
+
+    def reply_rpc_message(self, client_token, msg):
+        if client_token is None:
+            return
+        msg.pop("data", None)
+        self.reply_rpc_raw(client_token, msg.to_json().encode())
+
+    # -- peer gossip -------------------------------------------------------
+    def handle_peer(self, msg):
+        addr = msg.get("from")
+        if addr and addr != self.address:
+            info = msg.get_from_binary("info", {})
+            info["last_seen"] = time.time()
+            self.others[addr] = info
+
+    # -- RPC dispatch ------------------------------------------------------
+    def handle_rpc(self, client, payload):
+        token = binascii.hexlify(client).decode()
+        try:
+            msg = msg_factory(payload)
+        except messages.MalformedMessage:
+            self.reply_rpc_raw(token, b'{"payload": "malformed request"}')
+            return
+        msg["token"] = token
+        verb = msg.get("payload")
+        handler = getattr(self, f"rpc_{verb}", None)
+        if verb not in CONTROLLER_VERBS or handler is None:
+            err = ErrorMessage(msg)
+            err["payload"] = f"Sorry, unknown verb {verb!r}"
+            self.reply_rpc_message(token, err)
+            return
+        try:
+            handler(msg)
+        except Exception as exc:
+            self.logger.exception("rpc %s failed", verb)
+            err = ErrorMessage(msg)
+            err["payload"] = f"{type(exc).__name__}: {exc}"
+            self.reply_rpc_message(token, err)
+
+    def rpc_ping(self, msg):
+        reply = msg.copy()
+        reply["payload"] = "pong"
+        self.reply_rpc_message(msg["token"], reply)
+
+    def rpc_info(self, msg):
+        reply = msg.copy()
+        reply.add_as_binary("result", self.get_info())
+        self.reply_rpc_message(msg["token"], reply)
+
+    def get_info(self, include_peers=True):
+        info = {
+            "address": self.address,
+            "node": self.node_name,
+            "uptime": time.time() - self.start_time,
+            "msg_count_in": self.msg_count_in,
+            "workers": self.worker_map,
+            "worker_out_messages": {
+                str(k): len(v) for k, v in self.worker_out_messages.items()
+            },
+            "inflight": len(self.inflight),
+            "rpc_segments": len(self.rpc_segments),
+        }
+        if include_peers:
+            info["others"] = self.others
+        return info
+
+    def rpc_loglevel(self, msg):
+        args, _ = msg.get_args_kwargs()
+        self._fan_out_to_workers(msg)
+        self._fan_out_to_peers(msg)
+        import logging
+
+        level = {"debug": logging.DEBUG, "info": logging.INFO}.get(
+            args[0] if args else "info", logging.INFO
+        )
+        bqueryd_tpu.logger.setLevel(level)
+        reply = msg.copy()
+        reply["payload"] = "OK"
+        self.reply_rpc_message(msg["token"], reply)
+
+    def _fan_out_to_workers(self, msg):
+        for worker_id in list(self.worker_map):
+            fan = msg.copy()
+            fan.pop("token", None)
+            try:
+                self.socket.send_multipart(
+                    [worker_id.encode(), fan.to_json().encode()]
+                )
+            except zmq.ZMQError:
+                pass
+
+    def _fan_out_to_peers(self, msg):
+        if msg.get("_relayed"):
+            return  # no gossip storms
+        for addr in list(self.others):
+            fan = msg.copy()
+            fan.pop("token", None)
+            fan["_relayed"] = True
+            fan["payload_fan"] = True
+            try:
+                self.socket.send_multipart([addr.encode(), fan.to_json().encode()])
+            except zmq.ZMQError:
+                pass
+
+    def rpc_kill(self, msg):
+        reply = msg.copy()
+        reply["payload"] = "OK"
+        self.reply_rpc_message(msg["token"], reply)
+        self.running = False
+
+    def rpc_killworkers(self, msg):
+        kill = Message({"payload": "kill"})
+        self._fan_out_to_workers(kill)
+        reply = msg.copy()
+        reply["payload"] = "OK"
+        self.reply_rpc_message(msg["token"], reply)
+
+    def rpc_killall(self, msg):
+        self.rpc_killworkers(msg.copy())
+        if not msg.get("_relayed"):
+            for addr in list(self.others):
+                fan = RPCMessage({"payload": "killall", "_relayed": True})
+                try:
+                    self.socket.send_multipart(
+                        [addr.encode(), fan.to_json().encode()]
+                    )
+                except zmq.ZMQError:
+                    pass
+        reply = msg.copy()
+        reply["payload"] = "OK"
+        self.reply_rpc_message(msg["token"], reply)
+        self.running = False
+
+    def rpc_sleep(self, msg):
+        args, kwargs = msg.get_args_kwargs()
+        if args and isinstance(args[0], (list, tuple)):
+            # scatter without gather (reference bqueryd/controller.py:411-424)
+            for duration in args[0]:
+                scatter = CalcMessage({"payload": "sleep"})
+                scatter.set_args_kwargs([duration], {})
+                self.worker_out_messages[None].append(scatter)
+            reply = msg.copy()
+            reply["payload"] = "OK"
+            self.reply_rpc_message(msg["token"], reply)
+            return
+        calc = CalcMessage({"payload": "sleep", "token": msg["token"]})
+        calc.set_args_kwargs(args, kwargs)
+        self.worker_out_messages[None].append(calc)
+
+    def rpc_readfile(self, msg):
+        calc = CalcMessage(dict(msg))
+        calc["payload"] = "readfile"
+        self.worker_out_messages[None].append(calc)
+
+    def rpc_execute_code(self, msg):
+        args, kwargs = msg.get_args_kwargs()
+        if "function" not in kwargs and not msg.get("function"):
+            raise ValueError("execute_code requires function= kwarg")
+        wait = kwargs.pop("wait", False)
+        calc = CalcMessage(dict(msg))
+        calc["payload"] = "execute_code"
+        calc.set_args_kwargs(args, kwargs)
+        if not wait:
+            calc.pop("token", None)
+            self.worker_out_messages[None].append(calc)
+            reply = msg.copy()
+            reply["payload"] = "OK"
+            self.reply_rpc_message(msg["token"], reply)
+        else:
+            self.worker_out_messages[None].append(calc)
+
+    def rpc_download(self, msg):
+        from bqueryd_tpu.download import setup_download
+
+        setup_download(self, msg)
+
+    def release_ticket_waiters(self, ticket):
+        segment = self.rpc_segments.pop(f"ticket_{ticket}", None)
+        if segment is not None:
+            reply = segment["msg"].copy()
+            reply["payload"] = "DONE"
+            reply["ticket"] = ticket
+            self.reply_rpc_message(segment["client_token"], reply)
+
+    # -- groupby fan-out ---------------------------------------------------
+    def rpc_groupby(self, msg):
+        args, kwargs = msg.get_args_kwargs()
+        if len(args) != 4:
+            raise ValueError(
+                "groupby needs (filenames, groupby_cols, agg_list, where_terms)"
+            )
+        filenames, groupby_cols, agg_list, where_terms = args
+        if isinstance(filenames, str):
+            filenames = [filenames]
+        unknown = [f for f in filenames if f not in self.files_map]
+        if unknown:
+            raise ValueError(f"filenames not found on any worker: {unknown}")
+
+        parent_token = os.urandom(8).hex()
+        affinity = kwargs.get("affinity")
+        self.rpc_segments[parent_token] = {
+            "client_token": msg["token"],
+            "msg": msg,
+            "filenames": list(filenames),
+            "results": {},
+            "timings": {},
+            "created": time.time(),
+        }
+        for filename in filenames:
+            shard = CalcMessage({"payload": "groupby"})
+            shard.set_args_kwargs(
+                [filename, groupby_cols, agg_list, where_terms],
+                {
+                    k: v
+                    for k, v in kwargs.items()
+                    if k in ("aggregate", "expand_filter_column")
+                },
+            )
+            shard["token"] = os.urandom(8).hex()
+            shard["parent_token"] = parent_token
+            shard["filename"] = filename
+            shard["affinity"] = affinity
+            self.worker_out_messages.setdefault(affinity, []).append(shard)

@@ -1,0 +1,261 @@
+"""Dataset distribution: download tickets, locks, progress, two-phase commit.
+
+The host-side pipeline that gets sharded tables onto worker machines —
+capability match for the reference's downloader/movebcolz machinery:
+
+* the controller registers a **ticket**: one coordination-store hash per
+  download, one slot per (node, file-url), value ``"<timestamp>_<progress>"``
+  starting at ``-1`` (reference bqueryd/controller.py:435-469);
+* every downloader polls the tickets, claims (node, ticket, file) work with a
+  TTL lock, streams the blob into ``incoming/<ticket>/``, heartbeats progress
+  into the slot, marks it DONE (reference bqueryd/worker.py:358-498);
+* a cancelled ticket (slots deleted) aborts mid-flight downloads
+  (reference bqueryd/worker.py:418-428);
+* the **movebcolz** role watches the same tickets and, only when EVERY slot
+  on EVERY node is DONE, atomically swaps the new shard dirs into the serving
+  directory, writing a ``bqueryd.metadata`` provenance file into each —
+  the two-phase commit that flips all nodes in sync (reference
+  bqueryd/worker.py:570-637, README.md:153).
+"""
+
+import json
+import os
+import random
+import shutil
+import time
+import zipfile
+
+import bqueryd_tpu
+from bqueryd_tpu import blob as blob_mod
+from bqueryd_tpu.utils.fs import mkdir_p, rm_file_or_dir
+
+DONE = "DONE"
+METADATA_FILENAME = "bqueryd.metadata"
+
+
+def ticket_key(ticket):
+    return bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + ticket
+
+
+def lock_name(node, ticket, fileurl):
+    return bqueryd_tpu.REDIS_DOWNLOAD_LOCK_PREFIX + node + ticket + fileurl
+
+
+def set_progress(store, node, ticket, fileurl, progress):
+    store.hset(ticket_key(ticket), f"{node}_{fileurl}", f"{time.time()}_{progress}")
+
+
+def slot_state(value):
+    """Progress slot value -> the progress token after the last underscore."""
+    return value.rpartition("_")[2]
+
+
+# ---------------------------------------------------------------------------
+# controller side
+# ---------------------------------------------------------------------------
+
+def setup_download(controller, msg):
+    """Register a ticket for every (file, node) pair and either park the RPC
+    until a TicketDoneMessage (wait=True) or return the ticket immediately."""
+    _args, kwargs = msg.get_args_kwargs()
+    filenames = kwargs.get("filenames") or []
+    bucket = kwargs.get("bucket")
+    wait = kwargs.get("wait", False)
+    scheme = kwargs.get("scheme", "s3")
+    if not filenames or not bucket:
+        raise ValueError("download needs filenames=[...] and bucket=...")
+
+    nodes = sorted(
+        {info.get("node") for info in controller.worker_map.values() if info.get("node")}
+    )
+    if not nodes:
+        # no workers yet: register for this controller's own node so the
+        # ticket is still actionable by co-located downloaders
+        nodes = [controller.node_name]
+
+    ticket = os.urandom(8).hex()
+    for filename in filenames:
+        fileurl = f"{scheme}://{bucket}/{filename}"
+        for node in nodes:
+            set_progress(controller.store, node, ticket, fileurl, -1)
+
+    if wait:
+        controller.rpc_segments[f"ticket_{ticket}"] = {
+            "client_token": msg["token"],
+            "msg": msg,
+            "created": time.time(),
+        }
+    else:
+        reply = msg.copy()
+        reply.add_as_binary("result", ticket)
+        controller.reply_rpc_message(msg["token"], reply)
+
+
+# ---------------------------------------------------------------------------
+# downloader side
+# ---------------------------------------------------------------------------
+
+def incoming_dir(worker, ticket):
+    base = os.environ.get(
+        "BQUERYD_TPU_INCOMING", os.path.join(worker.data_dir, "incoming")
+    )
+    return os.path.join(base, ticket)
+
+
+def check_downloads(worker):
+    """One poll cycle: claim and run any pending slot for this node."""
+    keys = worker.store.keys(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + "*")
+    random.shuffle(keys)
+    node = worker.node_name
+    for key in keys:
+        ticket = key[len(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX):]
+        for slot, value in worker.store.hgetall(key).items():
+            slot_node, _, fileurl = slot.partition("_")
+            if slot_node != node or slot_state(value) == DONE:
+                continue
+            lock = worker.store.lock(
+                lock_name(node, ticket, fileurl),
+                ttl=bqueryd_tpu.REDIS_DOWNLOAD_LOCK_DURATION,
+            )
+            if not lock.acquire(blocking=False):
+                continue
+            try:
+                worker.download_file(ticket, fileurl)
+            except Exception:
+                worker.logger.exception("download %s failed", fileurl)
+                worker.remove_ticket(ticket)
+            finally:
+                lock.release()
+
+
+def get_backend(worker, scheme):
+    """Backend construction seam: tests and exotic deployments override this
+    (or the worker's ``blob_backend`` attribute) — the subclass-level seam
+    strategy of the reference tests (reference tests/test_download.py:25-45)."""
+    override = getattr(worker, "blob_backend", None)
+    if override is not None:
+        return override
+    return blob_mod.backend_for(scheme)
+
+
+def download_file(worker, ticket, fileurl, max_retries=3):
+    """Stream one blob into incoming/<ticket>/<filename>; zip archives are
+    extracted in place (shards travel zipped, reference bqueryd/worker.py:453,
+    500-505).  Mid-flight cancellation: if the ticket's slot disappears, the
+    download aborts and cleans up."""
+    scheme, bucket, key = blob_mod.parse_url(fileurl)
+    backend = get_backend(worker, scheme)
+    dest_dir = incoming_dir(worker, ticket)
+    mkdir_p(dest_dir)
+    filename = os.path.basename(key)
+    dest = os.path.join(dest_dir, filename)
+    final_target = os.path.join(dest_dir, _strip_zip(filename))
+    if os.path.exists(final_target) and final_target != dest:
+        # already present from an earlier attempt (reference bqueryd/worker.py:455-457)
+        set_progress(worker.store, worker.node_name, ticket, fileurl, DONE)
+        return
+
+    cancelled = CancelWatch(worker.store, worker.node_name, ticket, fileurl)
+
+    def progress(done):
+        if cancelled.check():
+            raise DownloadCancelled(fileurl)
+        set_progress(worker.store, worker.node_name, ticket, fileurl, done)
+
+    for attempt in range(max_retries):
+        try:
+            backend.fetch(bucket, key, dest, progress_cb=progress)
+            break
+        except DownloadCancelled:
+            worker.logger.info("download %s cancelled", fileurl)
+            rm_file_or_dir(dest_dir)
+            return
+        except Exception:
+            if attempt == max_retries - 1:
+                raise
+            worker.logger.warning(
+                "download %s attempt %d failed, retrying", fileurl, attempt + 1
+            )
+            time.sleep(0.5 * (attempt + 1))
+
+    if zipfile.is_zipfile(dest):
+        with zipfile.ZipFile(dest) as zf:
+            extract_dir = final_target
+            mkdir_p(extract_dir)
+            zf.extractall(extract_dir)
+        os.remove(dest)
+    set_progress(worker.store, worker.node_name, ticket, fileurl, DONE)
+
+
+def _strip_zip(filename):
+    return filename[:-4] if filename.endswith(".zip") else filename
+
+
+class DownloadCancelled(Exception):
+    pass
+
+
+class CancelWatch:
+    """Detects ticket cancellation (slot deleted client-side) without
+    hammering the store on every chunk."""
+
+    def __init__(self, store, node, ticket, fileurl, interval=2.0):
+        self.store = store
+        self.slot = f"{node}_{fileurl}"
+        self.key = ticket_key(ticket)
+        self.interval = interval
+        self._last = 0.0
+
+    def check(self):
+        now = time.time()
+        if now - self._last < self.interval:
+            return False
+        self._last = now
+        return self.store.hget(self.key, self.slot) is None
+
+
+def remove_ticket(worker, ticket):
+    """Drop this node's slots for a ticket and its staging dir."""
+    key = ticket_key(ticket)
+    node = worker.node_name
+    for slot in list(worker.store.hgetall(key)):
+        if slot.partition("_")[0] == node:
+            worker.store.hdel(key, slot)
+    rm_file_or_dir(incoming_dir(worker, ticket))
+
+
+# ---------------------------------------------------------------------------
+# movebcolz side (phase 2 of the commit)
+# ---------------------------------------------------------------------------
+
+def check_moves(worker):
+    """Activate a ticket only when every slot across ALL nodes is DONE and
+    this node staged files for it (reference bqueryd/worker.py:594-633)."""
+    for key in worker.store.keys(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + "*"):
+        ticket = key[len(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX):]
+        entries = worker.store.hgetall(key)
+        if not entries:
+            continue
+        if not all(slot_state(v) == DONE for v in entries.values()):
+            continue
+        staging = incoming_dir(worker, ticket)
+        if not os.path.isdir(staging):
+            continue
+        movebcolz(worker, ticket)
+
+
+def movebcolz(worker, ticket):
+    """Atomically swap staged shard dirs into the serving data_dir, stamping
+    provenance metadata into each (reference bqueryd/worker.py:573-592)."""
+    staging = incoming_dir(worker, ticket)
+    for name in sorted(os.listdir(staging)):
+        src = os.path.join(staging, name)
+        if not os.path.isdir(src):
+            continue
+        with open(os.path.join(src, METADATA_FILENAME), "w") as f:
+            json.dump({"ticket": ticket, "timestamp": time.time()}, f)
+        dest = os.path.join(worker.data_dir, name)
+        rm_file_or_dir(dest)
+        shutil.move(src, dest)
+        worker.logger.info("activated %s (ticket %s)", name, ticket)
+    worker.remove_ticket(ticket)

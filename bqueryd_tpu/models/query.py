@@ -1,0 +1,267 @@
+"""Query model + local execution engine (the framework's "flagship model").
+
+A groupby query (the payload of a ``CalcMessage``, same positional contract as
+the reference: ``(filename, groupby_col_list, agg_list, where_terms_list)``
+with kwargs ``aggregate`` / ``expand_filter_column``, reference
+bqueryd/worker.py:277-284) compiles to a pipeline of the kernels in
+:mod:`bqueryd_tpu.ops`:
+
+    storage decode -> H2D -> where-mask -> key codes -> packed composite ->
+    segment partials -> (mesh psum) -> finalize
+
+Results travel as :class:`ResultPayload`:
+
+* ``kind="partials"``: per-group partial tables **keyed by actual key values**
+  (not local codes), so payloads from different workers merge without any
+  cross-host dictionary coordination — the host-side merge in
+  :mod:`bqueryd_tpu.parallel.hostmerge` aligns them by key.  Mean partials
+  carry (sum, count): the correct weighted mean, not the reference's
+  sum-of-shard-means (reference bqueryd/rpc.py:171).
+* ``kind="rows"``: the ``aggregate=False`` raw-rows path — filtered selected
+  columns, concatenated client-side (reference bqueryd/worker.py:316-323,
+  rpc.py:172-173).
+* ``kind="empty"``: shard pruned by ``shard_can_match`` (the
+  factorization-check early-out, reference bqueryd/worker.py:296-301).
+"""
+
+import pickle
+from dataclasses import dataclass, field
+
+import numpy as np
+
+PAYLOAD_FORMAT = "bqueryd-tpu-result-1"
+
+
+@dataclass
+class GroupByQuery:
+    groupby_cols: list
+    agg_list: list          # [[in_col, op, out_col], ...]
+    where_terms: list = field(default_factory=list)
+    aggregate: bool = True
+    expand_filter_column: str = None
+
+    def __post_init__(self):
+        normalized = []
+        for agg in self.agg_list:
+            if isinstance(agg, str):
+                normalized.append([agg, "sum", agg])
+            elif len(agg) == 2:
+                normalized.append([agg[0], agg[1], agg[0]])
+            else:
+                normalized.append(list(agg))
+        self.agg_list = normalized
+
+    @property
+    def in_cols(self):
+        return [a[0] for a in self.agg_list]
+
+    @property
+    def ops(self):
+        return tuple(a[1] for a in self.agg_list)
+
+    @property
+    def out_cols(self):
+        return [a[2] for a in self.agg_list]
+
+
+class ResultPayload(dict):
+    """Wire form of a shard/worker result; a plain dict for pickling."""
+
+    @classmethod
+    def empty(cls):
+        return cls(format=PAYLOAD_FORMAT, kind="empty")
+
+    @classmethod
+    def rows(cls, columns, order):
+        return cls(format=PAYLOAD_FORMAT, kind="rows", columns=columns, order=order)
+
+    @classmethod
+    def partials(cls, key_cols, keys, rows, aggs, ops, out_cols):
+        return cls(
+            format=PAYLOAD_FORMAT,
+            kind="partials",
+            key_cols=list(key_cols),
+            keys=keys,        # {col: np.ndarray[G] of key values}
+            rows=rows,        # np.int64[G]
+            aggs=aggs,        # list of {partname: np.ndarray[G]}
+            ops=list(ops),
+            out_cols=list(out_cols),
+        )
+
+    def to_bytes(self):
+        return pickle.dumps(dict(self), protocol=4)
+
+    @classmethod
+    def from_bytes(cls, buf):
+        if not buf:
+            return cls.empty()
+        obj = pickle.loads(buf)
+        if obj.get("format") != PAYLOAD_FORMAT:
+            raise ValueError("unknown result payload format")
+        return cls(obj)
+
+
+class QueryEngine:
+    """Executes queries against local tpucolz tables on the local JAX device
+    (single-device path; the multi-device mesh path lives in
+    bqueryd_tpu.parallel.executor).  JAX imports happen lazily on first use so
+    control-plane processes can import this module freely."""
+
+    def __init__(self, timer=None):
+        self.timer = timer
+
+    def _phase(self, name):
+        import contextlib
+
+        if self.timer is None:
+            return contextlib.nullcontext()
+        return self.timer.phase(name)
+
+    # -- key handling ------------------------------------------------------
+    def _key_codes(self, table, col, mask_np=None):
+        """Physical dense codes + key-value array for one groupby column."""
+        from bqueryd_tpu import ops
+
+        kind = table.kind(col)
+        if kind == "dict":
+            codes = table.column_raw(col)
+            values = np.asarray(table.dictionary(col), dtype=object)
+            return codes, values
+        raw = table.column_raw(col)
+        codes, uniques = ops.factorize(raw)
+        if kind == "datetime":
+            uniques = uniques.view("datetime64[ns]")
+        return codes, uniques
+
+    # -- execution ---------------------------------------------------------
+    def execute_local(self, table, query: GroupByQuery) -> ResultPayload:
+        from bqueryd_tpu import ops
+
+        with self._phase("prune"):
+            if query.where_terms and not ops.shard_can_match(
+                table, query.where_terms
+            ):
+                return ResultPayload.empty()
+
+        with self._phase("mask"):
+            mask = ops.build_mask(table, query.where_terms)
+            if query.expand_filter_column:
+                basket_raw = table.column_raw(query.expand_filter_column)
+                basket_codes, basket_uniques = ops.factorize(basket_raw)
+                mask = ops.expand_mask_by_group(
+                    basket_codes, mask, n_groups=len(basket_uniques)
+                )
+
+        if not query.aggregate:
+            return self._raw_rows(table, query, mask)
+
+        with self._phase("factorize"):
+            per_key = [self._key_codes(table, c) for c in query.groupby_cols]
+            code_arrays = [np.asarray(c) for c, _ in per_key]
+            key_values = [v for _, v in per_key]
+            cards = [len(v) for v in key_values]
+            if len(code_arrays) == 1:
+                packed = code_arrays[0]
+            else:
+                packed = ops.pack_codes(code_arrays, cards)
+            dense, combos = ops.factorize(packed)
+            n_groups = max(len(combos), 1)
+
+        with self._phase("aggregate"):
+            mask_arr = None if mask is None else np.asarray(mask)
+            mergeable = [
+                (i, a) for i, a in enumerate(query.agg_list)
+                if a[1] in ops.MERGEABLE_OPS
+            ]
+            distinct = [
+                (i, a) for i, a in enumerate(query.agg_list)
+                if a[1] not in ops.MERGEABLE_OPS
+            ]
+            agg_parts = [None] * len(query.agg_list)
+            if mergeable:
+                measures = tuple(
+                    table.column_raw(a[0]) for _, a in mergeable
+                )
+                mops = tuple(a[1] for _, a in mergeable)
+                partials = ops.partial_tables(
+                    dense.astype(np.int32), measures, mops, n_groups, mask_arr
+                )
+                rows = np.asarray(partials["rows"])
+                for (i, _a), part in zip(mergeable, partials["aggs"]):
+                    agg_parts[i] = {
+                        k: np.asarray(v) for k, v in part.items()
+                    }
+            else:
+                # rows still needed to drop empty groups
+                import jax.numpy as jnp
+
+                valid = dense >= 0
+                if mask_arr is not None:
+                    valid = valid & mask_arr
+                rows = np.asarray(
+                    ops.partial_tables(
+                        dense.astype(np.int32),
+                        (np.zeros(len(dense)),),
+                        ("count",),
+                        n_groups,
+                        mask_arr,
+                    )["rows"]
+                )
+                del jnp
+            for i, agg in distinct:
+                in_col, op, _out = agg
+                vals = table.column_raw(in_col)
+                if op == "count_distinct":
+                    vcodes, vuniques = ops.factorize(vals)
+                    counts = ops.groupby_count_distinct(
+                        dense.astype(np.int32),
+                        vcodes,
+                        n_groups=n_groups,
+                        n_values=max(len(vuniques), 1),
+                        mask=mask_arr,
+                    )
+                elif op == "sorted_count_distinct":
+                    counts = ops.groupby_sorted_count_distinct(
+                        dense.astype(np.int32), vals, n_groups, mask_arr
+                    )
+                else:
+                    raise ValueError(f"unknown aggregation op {op!r}")
+                agg_parts[i] = {"distinct": np.asarray(counts)}
+
+        with self._phase("collect"):
+            present = rows > 0
+            combos_present = combos[present]
+            keys = {}
+            if len(query.groupby_cols) == 1:
+                key_codes = [combos_present]
+            else:
+                from bqueryd_tpu import ops as _ops
+
+                key_codes = _ops.unpack_codes(combos_present, cards)
+            for col, codes_g, values in zip(
+                query.groupby_cols, key_codes, key_values
+            ):
+                idx = np.asarray(codes_g, dtype=np.int64)
+                keys[col] = np.asarray(values)[idx]
+            aggs = [
+                {k: v[present] for k, v in part.items()} for part in agg_parts
+            ]
+            return ResultPayload.partials(
+                key_cols=query.groupby_cols,
+                keys=keys,
+                rows=np.asarray(rows)[present],
+                aggs=aggs,
+                ops=query.ops,
+                out_cols=query.out_cols,
+            )
+
+    def _raw_rows(self, table, query, mask):
+        column_list = list(query.groupby_cols) + list(query.in_cols)
+        seen = set()
+        column_list = [c for c in column_list if not (c in seen or seen.add(c))]
+        idx = None if mask is None else np.flatnonzero(np.asarray(mask))
+        columns = {}
+        for col in column_list:
+            values = table.column(col)
+            columns[col] = values if idx is None else values[idx]
+        return ResultPayload.rows(columns, column_list)

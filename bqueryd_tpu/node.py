@@ -1,0 +1,110 @@
+"""CLI entry point: ``bqueryd-tpu [controller|worker|downloader|movebcolz]``.
+
+Capability match for the reference CLI (reference bqueryd/node.py:14-47):
+role subcommands start daemons; with no subcommand an interactive shell opens
+with an ``rpc`` proxy connected to the cluster (IPython when available).
+Config comes from ``/etc/bqueryd_tpu.cfg`` (simple ``key = value`` lines:
+``coordination_url`` / ``redis_url``, ``azure_conn_string``), overridable by
+flags.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+CONFIG_FILE = os.environ.get("BQUERYD_TPU_CFG", "/etc/bqueryd_tpu.cfg")
+
+
+def read_config(path=CONFIG_FILE):
+    config = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                key, _, value = line.partition("=")
+                config[key.strip()] = value.strip().strip("'\"")
+    return config
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="bqueryd-tpu")
+    parser.add_argument(
+        "role",
+        nargs="?",
+        choices=["controller", "worker", "downloader", "movebcolz"],
+        help="daemon role; omit for an interactive RPC shell",
+    )
+    parser.add_argument(
+        "address",
+        nargs="?",
+        help="controller address for the RPC shell (tcp://ip:port)",
+    )
+    parser.add_argument("--data_dir", default=None)
+    parser.add_argument(
+        "--coordination",
+        default=None,
+        help="coordination store url (redis:// | mem:// | file://)",
+    )
+    parser.add_argument("-v", action="count", default=0, help="-v/-vv for debug")
+    args = parser.parse_args(argv)
+
+    config = read_config()
+    coordination_url = (
+        args.coordination
+        or os.environ.get("BQUERYD_TPU_COORDINATION_URL")
+        or config.get("coordination_url")
+        or config.get("redis_url")
+    )
+    if config.get("azure_conn_string"):
+        os.environ.setdefault(
+            "AZURE_STORAGE_CONNECTION_STRING", config["azure_conn_string"]
+        )
+    loglevel = logging.DEBUG if args.v else logging.INFO
+
+    kwargs = {"coordination_url": coordination_url, "loglevel": loglevel}
+
+    if args.role == "controller":
+        from bqueryd_tpu.controller import ControllerNode
+
+        ControllerNode(**kwargs).go()
+    elif args.role in ("worker", "downloader", "movebcolz"):
+        from bqueryd_tpu.worker import DownloaderNode, MoveBcolzNode, WorkerNode
+
+        cls = {
+            "worker": WorkerNode,
+            "downloader": DownloaderNode,
+            "movebcolz": MoveBcolzNode,
+        }[args.role]
+        if args.data_dir:
+            kwargs["data_dir"] = args.data_dir
+        cls(**kwargs).go()
+    else:
+        shell(args.address, coordination_url, loglevel)
+    return 0
+
+
+def shell(address, coordination_url, loglevel):
+    from bqueryd_tpu.rpc import RPC
+
+    rpc = RPC(
+        address=address, coordination_url=coordination_url, loglevel=loglevel
+    )
+    banner = (
+        f"bqueryd-tpu shell connected to {rpc.address}\n"
+        "use rpc.<verb>(...): info, groupby, download, sleep, killworkers, ..."
+    )
+    try:
+        import IPython
+
+        IPython.embed(banner1=banner, user_ns={"rpc": rpc})
+    except ImportError:
+        import code
+
+        code.interact(banner=banner, local={"rpc": rpc})
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,29 @@ int64 inputs silently degrade to int32.  Control-plane modules never import
 this package, so pure controller/downloader processes stay JAX-free.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Honor an explicitly requested platform even when the machine's sitecustomize
+# pre-registered a TPU-tunnel ("axon") backend factory: jax initializes every
+# registered factory on first use, so a CPU-only worker would still touch (and
+# potentially hang on) the tunnel.  When the requested platform list excludes
+# the tunnel, drop its factory outright.
+_requested = os.environ.get("BQUERYD_TPU_PLATFORM") or os.environ.get(
+    "JAX_PLATFORMS"
+)
+if _requested and "axon" not in _requested and "tpu" not in _requested:
+    jax.config.update("jax_platforms", _requested)
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+del _requested
 
 from bqueryd_tpu.ops.factorize import (  # noqa: E402
     factorize,
@@ -22,6 +42,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     AGG_OPS,
     MERGEABLE_OPS,
     combine_partials,
+    expand_mask_by_group,
     finalize,
     groupby_aggregate,
     groupby_count_distinct,
@@ -48,6 +69,7 @@ __all__ = [
     "groupby_aggregate",
     "groupby_count_distinct",
     "groupby_sorted_count_distinct",
+    "expand_mask_by_group",
     "partial_tables",
     "combine_partials",
     "psum_partials",

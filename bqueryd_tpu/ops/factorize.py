@@ -34,12 +34,7 @@ def factorize(values):
         return codes, uniques.astype(values.dtype)
     # float / other: NumPy unique (sorted) remapped to first-seen order
     uniques, inverse = np.unique(values, return_inverse=True)
-    first_pos = np.full(len(uniques), len(values), dtype=np.int64)
-    np.minimum.at(first_pos, inverse, np.arange(len(values)))
-    order = np.argsort(first_pos, kind="stable")
-    remap = np.empty(len(order), dtype=np.int64)
-    remap[order] = np.arange(len(order))
-    return remap[inverse].astype(np.int32), uniques[order]
+    return storage_codec.first_seen_order(uniques, inverse, len(values))
 
 
 def factorize_device(keys, capacity, fill_value=None):
@@ -59,7 +54,12 @@ def factorize_device(keys, capacity, fill_value=None):
     uniques, codes = jnp.unique(
         keys, return_inverse=True, size=capacity, fill_value=fill_value
     )
-    n_uniques = jnp.sum(uniques != fill_value).astype(jnp.int32)
+    # count uniques from the codes, not by comparing against fill_value —
+    # real data may contain the fill value itself
+    if codes.size:
+        n_uniques = (codes.max() + 1).astype(jnp.int32)
+    else:
+        n_uniques = jnp.int32(0)
     return uniques, codes.astype(jnp.int32), n_uniques
 
 

@@ -237,6 +237,34 @@ def groupby_count_distinct(codes, value_codes, n_groups, n_values, mask=None):
     )
 
 
+def expand_mask_by_group(group_codes, mask, n_groups=None):
+    """Expand a row mask to whole groups: every row whose group contains at
+    least one selected row becomes selected (the basket-expansion semantics of
+    ``is_in_ordered_subgroups(basket_col, bool_arr)`` at reference
+    bqueryd/worker.py:306-307, without requiring sorted input).
+
+    segment-max of the mask over group codes, gathered back to rows.
+    Negative codes (null baskets) are never selected.  Pass ``n_groups`` (the
+    dense code cardinality) to keep the scatter O(groups); it defaults to the
+    safe-but-wasteful row count."""
+    if mask is None:
+        return None
+    group_codes = jnp.asarray(group_codes)
+    if n_groups is None:
+        n_groups = group_codes.shape[0]
+    return _expand_mask_jit(group_codes, jnp.asarray(mask), int(n_groups))
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups",))
+def _expand_mask_jit(group_codes, mask, n_groups):
+    valid = group_codes >= 0
+    safe = jnp.where(valid, group_codes, 0).astype(jnp.int32)
+    hit = jax.ops.segment_max(
+        (mask & valid).astype(jnp.int32), safe, num_segments=max(n_groups, 1),
+    )
+    return (hit[safe] > 0) & valid
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups",))
 def groupby_sorted_count_distinct(codes, values, n_groups, mask=None):
     """bquery's ``sorted_count_distinct``: counts value *runs* per group,

@@ -34,12 +34,21 @@ def _to_ns(value):
     return int(pd.Timestamp(value).value)
 
 
-def translate_value(table, column, value):
-    """Translate a user-facing term value into physical column space."""
+def translate_value(table, column, value, op="=="):
+    """Translate a user-facing term value into physical column space.
+
+    Range ops on dict columns are rejected: dictionary codes are in
+    first-seen order, so ``<``/``>`` over codes would compare ingestion order,
+    not values."""
     if isinstance(value, (set, frozenset)):
         value = list(value)  # sets accepted for in/not-in on any column kind
     kind = table.kind(column)
     if kind == "dict":
+        if op in ("<", "<=", ">", ">="):
+            raise ValueError(
+                f"range op {op!r} is not supported on dictionary-encoded "
+                f"column {column!r} (codes are unordered)"
+            )
         lookup = table.dict_lookup(column)
         if isinstance(value, (list, tuple)):
             return [lookup.get(str(v), -2) for v in value]
@@ -88,7 +97,7 @@ def build_mask(table, where_terms_list, column_getter=None):
     mask = None
     for term in where_terms_list:
         column, op, value = term
-        phys = translate_value(table, column, value)
+        phys = translate_value(table, column, value, op)
         m = term_mask(get(column), op, phys)
         mask = m if mask is None else (mask & m)
     return mask
@@ -102,38 +111,48 @@ def shard_can_match(table, where_terms_list):
         column, op, value = term
         if column not in table:
             continue
-        kind = table.kind(column)
-        if kind == "dict":
-            phys = translate_value(table, column, value)
-            if op == "==" and phys == -2:
+        try:
+            kind = table.kind(column)
+            if kind == "dict":
+                phys = translate_value(table, column, value, op)
+                if op == "==" and phys == -2:
+                    return False
+                if op == "in" and isinstance(phys, list) and all(
+                    p == -2 for p in phys
+                ):
+                    return False
+                continue
+            stats = table.col_stats(column)
+            if stats is None:
+                continue
+            lo, hi = stats
+            if kind == "datetime":
+                value_phys = translate_value(table, column, value, op)
+            else:
+                value_phys = value
+            if op == "==" and not (
+                isinstance(value_phys, (list, tuple))
+            ) and (value_phys < lo or value_phys > hi):
                 return False
-            if op == "in" and isinstance(phys, list) and all(p == -2 for p in phys):
+            if op == ">" and hi <= value_phys:
                 return False
+            if op == ">=" and hi < value_phys:
+                return False
+            if op == "<" and lo >= value_phys:
+                return False
+            if op == "<=" and lo > value_phys:
+                return False
+            if op == "in" and isinstance(value_phys, (list, tuple)) and all(
+                v < lo or v > hi for v in value_phys
+            ):
+                return False
+        except ValueError:
+            raise  # range-op-on-dict is a real query error, surface it
+        except TypeError:
+            # value not comparable with stats (wrong type, etc.): pruning is
+            # best-effort — conservatively keep the shard and let the mask
+            # path produce the proper error or coercion
             continue
-        stats = table.col_stats(column)
-        if stats is None:
-            continue
-        lo, hi = stats
-        if kind == "datetime":
-            value_phys = translate_value(table, column, value)
-        else:
-            value_phys = value
-        if op == "==" and not (
-            isinstance(value_phys, (list, tuple))
-        ) and (value_phys < lo or value_phys > hi):
-            return False
-        if op == ">" and hi <= value_phys:
-            return False
-        if op == ">=" and hi < value_phys:
-            return False
-        if op == "<" and lo >= value_phys:
-            return False
-        if op == "<=" and lo > value_phys:
-            return False
-        if op == "in" and isinstance(value_phys, (list, tuple)) and all(
-            v < lo or v > hi for v in value_phys
-        ):
-            return False
     return True
 
 

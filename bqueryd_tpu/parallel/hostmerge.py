@@ -1,0 +1,196 @@
+"""Host-side (NumPy-only, JAX-free) merge of value-keyed result payloads.
+
+This is the cross-worker half of the merge architecture: within a worker,
+shard partials merge on-device over the ICI mesh (``ops.psum_partials``);
+across workers — the DCN boundary — payloads carry actual key values, and this
+module aligns and combines them on the host.  It deliberately imports no JAX
+so the client and controller processes stay accelerator-free.
+
+Replaces the reference's merge pipeline (controller tar-of-tars at reference
+bqueryd/controller.py:186-211 + client-side re-groupby with every op forced to
+'sum' at reference bqueryd/rpc.py:159-173), with two semantic fixes, flagged
+per SURVEY.md §7.4:
+
+* ``mean`` merges as (sum, count) -> weighted mean, not sum-of-shard-means;
+* ``min``/``max`` merge as min/max, which the reference's forced-'sum' merge
+  silently corrupted.
+
+Known reference-compatible limitation: ``count_distinct`` partials merge by
+addition across *workers* (distinct sets are not shipped), so values present
+on multiple workers are double-counted — exactly the reference's behaviour
+for values spanning shards.  Within one worker the count is exact.
+"""
+
+import numpy as np
+
+_MERGE_RULES = {
+    "sum": np.add,
+    "count": np.add,
+    "distinct": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def merge_payloads(payloads):
+    """Merge a list of ResultPayload dicts into one.
+
+    Mixed kinds: 'empty' payloads are dropped; remaining payloads must agree
+    on kind.  Returns a single payload dict (kind 'empty' if all were).
+    """
+    live = [p for p in payloads if p.get("kind") != "empty"]
+    if not live:
+        return {"format": "bqueryd-tpu-result-1", "kind": "empty"}
+    kinds = {p["kind"] for p in live}
+    if kinds == {"rows"}:
+        return _merge_rows(live)
+    if kinds == {"partials"}:
+        return _merge_partials(live)
+    raise ValueError(f"cannot merge mixed payload kinds: {sorted(kinds)}")
+
+
+def _merge_rows(payloads):
+    order = payloads[0]["order"]
+    for p in payloads[1:]:
+        if p["order"] != order:
+            raise ValueError("row payloads have mismatched columns")
+    columns = {
+        col: np.concatenate([p["columns"][col] for p in payloads])
+        for col in order
+    }
+    return {
+        "format": payloads[0]["format"],
+        "kind": "rows",
+        "columns": columns,
+        "order": order,
+    }
+
+
+def _merge_partials(payloads):
+    first = payloads[0]
+    key_cols = first["key_cols"]
+    ops = first["ops"]
+    out_cols = first["out_cols"]
+    for p in payloads[1:]:
+        if p["key_cols"] != key_cols or p["ops"] != ops or p["out_cols"] != out_cols:
+            raise ValueError("partial payloads disagree on query shape")
+    if len(payloads) == 1:
+        return dict(first)
+
+    # Align groups by key tuple: global index = first-seen order.
+    index = {}
+    group_of = []  # per payload: array mapping local group -> global group
+    for p in payloads:
+        key_arrays = [np.asarray(p["keys"][c]) for c in key_cols]
+        local = np.empty(len(p["rows"]), dtype=np.int64)
+        for g, key in enumerate(zip(*key_arrays)) if key_arrays else []:
+            local[g] = index.setdefault(key, len(index))
+        group_of.append(local)
+    n_global = len(index)
+
+    def scatter(rule, parts, dtype):
+        if rule in (np.minimum, np.maximum):
+            fill = (
+                np.inf if rule is np.minimum else -np.inf
+            ) if np.issubdtype(dtype, np.floating) else (
+                np.iinfo(dtype).max if rule is np.minimum else np.iinfo(dtype).min
+            )
+            out = np.full(n_global, fill, dtype=dtype)
+        else:
+            out = np.zeros(n_global, dtype=dtype)
+        for local_map, arr in parts:
+            rule.at(out, local_map, arr)
+        return out
+
+    rows = scatter(
+        np.add, [(g, np.asarray(p["rows"])) for g, p in zip(group_of, payloads)],
+        np.int64,
+    )
+    aggs = []
+    for ai in range(len(ops)):
+        part_names = first["aggs"][ai].keys()
+        merged = {}
+        for pname in part_names:
+            rule = _MERGE_RULES[pname]
+            parts = [
+                (g, np.asarray(p["aggs"][ai][pname]))
+                for g, p in zip(group_of, payloads)
+            ]
+            merged[pname] = scatter(rule, parts, parts[0][1].dtype)
+        aggs.append(merged)
+
+    # global key arrays in first-seen order
+    keys = {}
+    key_tuples = list(index.keys())
+    for ci, col in enumerate(key_cols):
+        sample = np.asarray(first["keys"][col])
+        keys[col] = np.array(
+            [t[ci] for t in key_tuples],
+            dtype=sample.dtype if sample.dtype != object else object,
+        )
+    return {
+        "format": first["format"],
+        "kind": "partials",
+        "key_cols": key_cols,
+        "keys": keys,
+        "rows": rows,
+        "aggs": aggs,
+        "ops": ops,
+        "out_cols": out_cols,
+    }
+
+
+def finalize_table(merged):
+    """Finalize a merged payload into plain arrays:
+    ``(order, {col: np.ndarray})``.  NumPy mirror of ``ops.finalize`` (kept
+    in lockstep by tests/test_query_model.py::test_host_finalize_matches_device).
+
+    (Callers wanting the reference's legacy sum-of-shard-means quirk finalize
+    each payload separately and sum the means — see RPC's legacy_merge flag.)"""
+    if merged["kind"] == "empty":
+        return [], {}
+    if merged["kind"] == "rows":
+        return merged["order"], merged["columns"]
+
+    out_cols = merged["out_cols"]
+    order = list(merged["key_cols"]) + list(out_cols)
+    columns = dict(merged["keys"])
+    rows = merged["rows"]
+    for agg, op, out_col in zip(merged["aggs"], merged["ops"], out_cols):
+        if op == "mean":
+            count = agg["count"]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                values = np.where(
+                    count > 0, agg["sum"] / np.maximum(count, 1), np.nan
+                )
+        elif op == "sum":
+            values = agg["sum"]
+        elif op in ("count", "count_na"):
+            values = agg["count"]
+        elif op in ("count_distinct", "sorted_count_distinct"):
+            values = agg["distinct"]
+        elif op in ("min", "max"):
+            values = agg[op]
+            empty = agg["count"] == 0
+            if np.issubdtype(values.dtype, np.floating):
+                values = np.where(empty, np.nan, values)
+            else:
+                values = np.where(empty, 0, values)
+        else:
+            raise ValueError(f"cannot finalize op {op!r}")
+        columns[out_col] = values
+
+    present = rows > 0
+    if not present.all():
+        columns = {c: v[present] for c, v in columns.items()}
+    return order, columns
+
+
+def payload_to_dataframe(merged):
+    """Final client-side conversion (pandas import isolated here)."""
+    import pandas as pd
+
+    order, columns = finalize_table(merged)
+    if not order:
+        return pd.DataFrame()
+    return pd.DataFrame({c: columns[c] for c in order}, columns=order)

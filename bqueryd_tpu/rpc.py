@@ -1,0 +1,216 @@
+"""RPC: the client proxy object.
+
+Same call surface as the reference client (reference bqueryd/rpc.py:29-207):
+attribute access becomes a remote call on a randomly chosen live controller
+(``rpc.groupby(...)``, ``rpc.info()``, ...), with ping-verified connection,
+reconnect-and-retry, and ``last_call_duration`` timing.
+
+The groupby result path is redesigned: instead of a tar-of-tars that the
+client untars and re-aggregates through bcolz (reference bqueryd/rpc.py:135-175),
+the controller returns one pickled list of per-shard partial payloads (already
+psum-merged across each worker's device mesh) and the client does a value-keyed
+NumPy merge + finalize (:mod:`bqueryd_tpu.parallel.hostmerge`).  Mean is a
+correct weighted mean; ``legacy_merge=True`` restores the reference's
+sum-of-shard-means quirk (reference bqueryd/rpc.py:171) for byte-compatible
+comparisons.
+"""
+
+import logging
+import os
+import pickle
+import random
+import time
+
+import zmq
+
+import bqueryd_tpu
+from bqueryd_tpu import messages
+from bqueryd_tpu.coordination import coordination_store
+from bqueryd_tpu.messages import ErrorMessage, RPCMessage, msg_factory
+
+
+class RPCError(Exception):
+    pass
+
+
+class RPC:
+    def __init__(
+        self,
+        address=None,
+        timeout=120,
+        coordination_url=None,
+        redis_url=None,
+        loglevel=logging.INFO,
+        retries=3,
+        legacy_merge=False,
+    ):
+        bqueryd_tpu.configure_logging(loglevel)
+        self.logger = bqueryd_tpu.logger.getChild("rpc")
+        self.timeout = timeout
+        self.retries = retries
+        self.legacy_merge = legacy_merge
+        self.last_call_duration = None
+        self.identity = os.urandom(8).hex()
+        self.store = coordination_store(
+            coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
+        )
+        self.context = zmq.Context.instance()
+        self.socket = None
+        self.address = None
+        self.connect(address)
+
+    # -- connection --------------------------------------------------------
+    def connect(self, address=None):
+        if address:
+            candidates = [address]
+        else:
+            candidates = list(self.store.smembers(bqueryd_tpu.REDIS_SET_KEY))
+            random.shuffle(candidates)
+        if not candidates:
+            raise RPCError("No controllers found in the coordination store")
+        for candidate in candidates:
+            if self._try_connect(candidate):
+                self.address = candidate
+                self.logger.debug("connected to controller %s", candidate)
+                return
+        raise RPCError(f"No controller answered a ping among {candidates}")
+
+    def _try_connect(self, address, ping_timeout=2000):
+        self._close_socket()
+        self.socket = self.context.socket(zmq.REQ)
+        self.socket.identity = self.identity.encode()
+        self.socket.setsockopt(zmq.LINGER, 0)
+        self.socket.connect(address)
+        ping = RPCMessage({"payload": "ping"})
+        ping.set_args_kwargs([], {})
+        self.socket.send(ping.to_json().encode())
+        if self.socket.poll(ping_timeout, zmq.POLLIN):
+            reply = msg_factory(self.socket.recv())
+            return reply.get("payload") == "pong"
+        self._close_socket()
+        return False
+
+    def _close_socket(self):
+        if self.socket is not None:
+            self.socket.close()
+            self.socket = None
+
+    # -- proxy -------------------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def remote_call(*args, **kwargs):
+            return self._rpc(name, args, kwargs)
+
+        remote_call.__name__ = name
+        return remote_call
+
+    def _rpc(self, name, args, kwargs):
+        started = time.time()
+        msg = RPCMessage({"payload": name})
+        msg.set_args_kwargs(list(args), kwargs)
+        wire = msg.to_json().encode()
+        reply = None
+        last_error = None
+        for attempt in range(self.retries):
+            try:
+                if self.socket is None:
+                    self.connect()
+                self.socket.send(wire)
+                if self.socket.poll(int(self.timeout * 1000), zmq.POLLIN):
+                    reply = self.socket.recv()
+                    break
+                last_error = f"timeout after {self.timeout}s"
+            except zmq.ZMQError as exc:
+                last_error = str(exc)
+            self.logger.warning(
+                "rpc %s attempt %d failed (%s), reconnecting",
+                name, attempt + 1, last_error,
+            )
+            try:
+                self.connect()
+            except RPCError as exc:
+                last_error = str(exc)
+        if reply is None:
+            raise RPCError(f"rpc {name} failed: {last_error}")
+        result = self._parse_reply(name, reply)
+        self.last_call_duration = time.time() - started
+        return result
+
+    def _parse_reply(self, name, reply):
+        if name == "groupby":
+            return self._parse_groupby_reply(reply)
+        msg = msg_factory(reply)
+        if isinstance(msg, ErrorMessage):
+            raise RPCError(msg.get("payload"))
+        if "result" in msg:
+            return msg.get_from_binary("result")
+        return msg.get("payload")
+
+    def _parse_groupby_reply(self, reply):
+        from bqueryd_tpu.models.query import ResultPayload
+        from bqueryd_tpu.parallel import hostmerge
+
+        # error replies come back as JSON messages; results as raw pickle
+        if reply[:1] == b"{":
+            msg = msg_factory(reply)
+            raise RPCError(msg.get("payload"))
+        envelope = pickle.loads(reply)
+        if not envelope.get("ok"):
+            raise RPCError(envelope.get("error"))
+        payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
+        self.last_call_timings = envelope.get("timings")
+        if self.legacy_merge:
+            return self._legacy_merge_frames(payloads)
+        merged = hostmerge.merge_payloads(payloads)
+        return hostmerge.payload_to_dataframe(merged)
+
+    def _legacy_merge_frames(self, payloads):
+        """Reference-quirk mode: finalize each shard separately, then re-merge
+        every measure with 'sum' — reproducing sum-of-shard-means for mean
+        (reference bqueryd/rpc.py:159-173)."""
+        import pandas as pd
+
+        from bqueryd_tpu.parallel import hostmerge
+
+        frames = []
+        key_cols = None
+        for payload in payloads:
+            if payload.get("kind") == "empty":
+                continue
+            key_cols = payload.get("key_cols", key_cols)
+            frames.append(
+                hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload]))
+            )
+        if not frames:
+            return pd.DataFrame()
+        stacked = pd.concat(frames, ignore_index=True)
+        if key_cols is None:
+            return stacked
+        return stacked.groupby(key_cols, sort=True).sum().reset_index()
+
+    # -- download helpers (client-local, straight to the store) ------------
+    def downloads(self):
+        """Progress of in-flight download tickets, read client-side from the
+        coordination store (reference bqueryd/rpc.py:181-199)."""
+        out = []
+        prefix = bqueryd_tpu.REDIS_TICKET_KEY_PREFIX
+        for key in self.store.keys(prefix + "*"):
+            ticket = key[len(prefix):]
+            entries = self.store.hgetall(key)
+            progress = {}
+            for slot, value in entries.items():
+                node, _, fileurl = slot.partition("_")
+                timestamp, _, state = value.rpartition("_")
+                progress[(node, fileurl)] = state
+            out.append((ticket, progress))
+        return out
+
+    def delete_download(self, ticket):
+        """Cancel a ticket by deleting its slots; downloaders abort mid-flight
+        on the next progress update (reference bqueryd/worker.py:418-428)."""
+        key = bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + ticket
+        existed = bool(self.store.hgetall(key))
+        self.store.delete(key)
+        return existed

@@ -154,15 +154,20 @@ def _verify_crcs(file_buf, chunks):
             raise ValueError(f"corrupt chunk {i}: CRC mismatch")
 
 
+def first_seen_order(uniques, inverse, n_values):
+    """Re-order np.unique output (sorted) into first-seen order:
+    returns (codes int32, uniques reordered)."""
+    first_pos = np.full(len(uniques), n_values, dtype=np.int64)
+    np.minimum.at(first_pos, inverse, np.arange(n_values))
+    order = np.argsort(first_pos, kind="stable")
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    return remap[inverse].astype(np.int32), uniques[order]
+
+
 def factorize_i64(values: np.ndarray):
     """Dense-code int64 values in first-seen order -> (codes i32, uniques i64)."""
     if native.available():
         return native.factorize_i64(values)
-    uniques, codes = np.unique(values, return_inverse=True)
-    # np.unique sorts; re-order to first-seen to match the native contract
-    first_pos = np.full(len(uniques), len(values), dtype=np.int64)
-    np.minimum.at(first_pos, codes, np.arange(len(values)))
-    order = np.argsort(first_pos, kind="stable")
-    remap = np.empty_like(order)
-    remap[order] = np.arange(len(order))
-    return remap[codes].astype(np.int32), uniques[order]
+    uniques, inverse = np.unique(values, return_inverse=True)
+    return first_seen_order(uniques, inverse, len(values))

@@ -394,7 +394,9 @@ class ctable:
                     series.to_numpy(dtype=object), use_na_sentinel=True
                 )
                 local_codes = np.asarray(local_codes)
-                lookup = {v: i for i, v in enumerate(dictionary)}
+                # memoized mapping; mutated in place alongside the dictionary
+                # (length-based invalidation in dict_lookup stays correct)
+                lookup = self.dict_lookup(name)
                 remap = np.empty(len(local_uniques), dtype=np.int32)
                 for j, v in enumerate(local_uniques):
                     v = str(v)

@@ -1,0 +1,190 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.models.query import GroupByQuery, QueryEngine, ResultPayload
+from bqueryd_tpu.parallel import hostmerge
+from bqueryd_tpu.storage import ctable
+
+
+def taxi_like_df(n=15_000, seed=2):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "VendorID": rng.integers(1, 3, n).astype(np.int64),
+            "passenger_count": rng.integers(0, 7, n).astype(np.int64),
+            "payment_type": rng.integers(1, 5, n).astype(np.int64),
+            "trip_distance": rng.exponential(3.0, n),
+            "fare_amount": rng.gamma(2.0, 7.0, n),
+            "total_amount": rng.gamma(2.5, 8.0, n),
+            "flag": rng.choice(["Y", "N"], n),
+            "basket_id": np.sort(rng.integers(0, n // 4, n)).astype(np.int64),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    df = taxi_like_df()
+    root = str(tmp_path_factory.mktemp("qm") / "taxi.bcolz")
+    ctable.fromdataframe(df, root)
+    return df, ctable(root, mode="r")
+
+
+def run_query(table, *args, **kw):
+    df, ct = table
+    query = GroupByQuery(*args, **kw)
+    payload = QueryEngine().execute_local(ct, query)
+    wire = ResultPayload.from_bytes(payload.to_bytes())  # exercise wire hop
+    return df, hostmerge.payload_to_dataframe(hostmerge.merge_payloads([wire]))
+
+
+def assert_frames_match(got, expected, key_cols):
+    got = got.sort_values(key_cols).reset_index(drop=True)
+    expected = expected.sort_values(key_cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False,
+                                  check_index_type=False)
+
+
+def test_single_key_sum(table):
+    df, got = run_query(
+        table, ["payment_type"], [["total_amount", "sum", "total_amount"]]
+    )
+    expected = df.groupby("payment_type")["total_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_multi_key_multi_agg(table):
+    df, got = run_query(
+        table,
+        ["VendorID", "payment_type"],
+        [
+            ["fare_amount", "sum", "fare_sum"],
+            ["fare_amount", "mean", "fare_mean"],
+            ["passenger_count", "count", "n"],
+        ],
+    )
+    g = df.groupby(["VendorID", "payment_type"])
+    expected = pd.DataFrame(
+        {
+            "fare_sum": g["fare_amount"].sum(),
+            "fare_mean": g["fare_amount"].mean(),
+            "n": g["passenger_count"].count(),
+        }
+    ).reset_index()
+    assert_frames_match(got, expected, ["VendorID", "payment_type"])
+
+
+def test_string_key(table):
+    df, got = run_query(table, ["flag"], [["fare_amount", "sum", "fare_amount"]])
+    expected = df.groupby("flag")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["flag"])
+
+
+def test_where_filter(table):
+    df, got = run_query(
+        table,
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        where_terms=[("trip_distance", ">", 4.0)],
+    )
+    expected = (
+        df[df.trip_distance > 4.0]
+        .groupby("payment_type")["total_amount"].sum().reset_index()
+    )
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_unmatchable_filter_prunes_to_empty(table):
+    df, got = run_query(
+        table,
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        where_terms=[("payment_type", "==", 999)],
+    )
+    assert got.empty
+
+
+def test_count_distinct(table):
+    df, got = run_query(
+        table,
+        ["payment_type"],
+        [["passenger_count", "count_distinct", "nuniq"]],
+    )
+    expected = (
+        df.groupby("payment_type")["passenger_count"].nunique()
+        .reset_index().rename(columns={"passenger_count": "nuniq"})
+    )
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_raw_rows_mode(table):
+    df, got = run_query(
+        table,
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        where_terms=[("trip_distance", ">", 8.0)],
+        aggregate=False,
+    )
+    expected = df.loc[
+        df.trip_distance > 8.0, ["payment_type", "total_amount"]
+    ].reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True), expected, check_dtype=False
+    )
+
+
+def test_basket_expansion(table):
+    df, got = run_query(
+        table,
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        where_terms=[("trip_distance", ">", 10.0)],
+        expand_filter_column="basket_id",
+    )
+    hit_baskets = df.loc[df.trip_distance > 10.0, "basket_id"].unique()
+    expanded = df[df.basket_id.isin(hit_baskets)]
+    expected = expanded.groupby("payment_type")["total_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_cross_worker_merge_matches_full(table):
+    """Payloads computed on disjoint row sets (as different workers would)
+    must merge into exactly the unsharded result."""
+    df, _ = table
+    query = GroupByQuery(
+        ["payment_type"],
+        [
+            ["fare_amount", "sum", "s"],
+            ["fare_amount", "mean", "m"],
+            ["fare_amount", "min", "lo"],
+            ["fare_amount", "max", "hi"],
+        ],
+    )
+    engine = QueryEngine()
+    payloads = []
+    import tempfile
+
+    for i in range(3):
+        part = df.iloc[i::3]
+        root = tempfile.mkdtemp() + "/part.bcolzs"
+        ctable.fromdataframe(part, root)
+        payloads.append(engine.execute_local(ctable(root, "r"), query))
+    merged = hostmerge.merge_payloads(payloads)
+    got = hostmerge.payload_to_dataframe(merged)
+    g = df.groupby("payment_type")["fare_amount"]
+    expected = pd.DataFrame(
+        {"s": g.sum(), "m": g.mean(), "lo": g.min(), "hi": g.max()}
+    ).reset_index()
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_merge_empty_payloads():
+    merged = hostmerge.merge_payloads([ResultPayload.empty(), ResultPayload.empty()])
+    assert merged["kind"] == "empty"
+    assert hostmerge.payload_to_dataframe(merged).empty
+
+
+def test_agg_list_normalization():
+    q = GroupByQuery(["k"], ["v", ["w", "mean"], ["x", "sum", "y"]])
+    assert q.agg_list == [["v", "sum", "v"], ["w", "mean", "w"], ["x", "sum", "y"]]

@@ -1,0 +1,271 @@
+"""Full-cluster integration tests: controller + workers + client in one
+process (threads as nodes — the reference's own test topology, reference
+tests/test_simple_rpc.py:42-74, with condition polling instead of sleeps)."""
+
+import logging
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import wait_until
+
+NR_SHARDS = 5
+
+
+def taxi_like_df(n=12_000, seed=4):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "payment_type": rng.integers(1, 5, n).astype(np.int64),
+            "passenger_count": rng.integers(0, 7, n).astype(np.int64),
+            "trip_distance": rng.exponential(3.0, n),
+            "total_amount": rng.gamma(2.5, 8.0, n),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def taxi_df():
+    return taxi_like_df()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory, taxi_df):
+    from bqueryd_tpu.storage import ctable
+
+    root = tmp_path_factory.mktemp("cluster_data")
+    ctable.fromdataframe(taxi_df, str(root / "taxi.bcolz"))
+    for i in range(NR_SHARDS):
+        ctable.fromdataframe(
+            taxi_df.iloc[i::NR_SHARDS], str(root / f"taxi-{i}.bcolzs")
+        )
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def cluster(data_dir):
+    import bqueryd_tpu
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.worker import DownloaderNode, WorkerNode
+
+    url = f"mem://cluster-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=data_dir,
+        heartbeat_interval=0.2,
+        dead_worker_timeout=10.0,
+    )
+    worker = WorkerNode(
+        coordination_url=url,
+        data_dir=data_dir,
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.2,
+        poll_timeout=0.1,
+    )
+
+    class DummyDownloader(DownloaderNode):
+        """Fakes the blob fetch but stages a real file so the movebcolz
+        two-phase commit runs (the reference's DummyDownloader seam,
+        reference tests/test_simple_rpc.py:36-39)."""
+
+        def download_file(self, ticket, fileurl):
+            from bqueryd_tpu.download import incoming_dir
+
+            staging = incoming_dir(self, ticket)
+            name = os.path.basename(fileurl)
+            os.makedirs(os.path.join(staging, name), exist_ok=True)
+            self.file_downloader_progress(ticket, fileurl, "DONE")
+
+    downloader = DummyDownloader(
+        coordination_url=url,
+        data_dir=data_dir,
+        loglevel=logging.WARNING,
+        heartbeat_interval=0.2,
+        poll_timeout=0.1,
+    )
+    downloader.download_interval = 0.2
+
+    from bqueryd_tpu.worker import MoveBcolzNode
+
+    mover = MoveBcolzNode(
+        coordination_url=url,
+        data_dir=data_dir,
+        loglevel=logging.WARNING,
+        heartbeat_interval=0.2,
+        poll_timeout=0.1,
+    )
+    mover.download_interval = 0.2
+
+    threads = [
+        threading.Thread(target=node.go, daemon=True)
+        for node in (controller, worker, downloader, mover)
+    ]
+    for t in threads:
+        t.start()
+
+    wait_until(
+        lambda: controller.files_map.get("taxi.bcolz"),
+        desc="worker registration with data files",
+    )
+    wait_until(
+        lambda: len(controller.worker_map) >= 3,
+        desc="all workers registered",
+    )
+    from bqueryd_tpu.rpc import RPC
+
+    rpc = RPC(coordination_url=url, timeout=60, loglevel=logging.WARNING)
+    yield {
+        "rpc": rpc,
+        "controller": controller,
+        "worker": worker,
+        "downloader": downloader,
+        "mover": mover,
+        "url": url,
+    }
+    for node in (controller, worker, downloader, mover):
+        node.running = False
+    for t in threads:
+        t.join(timeout=5)
+
+
+def test_ping(cluster):
+    assert cluster["rpc"].ping() == "pong"
+
+
+def test_info_shape(cluster):
+    info = cluster["rpc"].info()
+    assert info["address"] == cluster["controller"].address
+    workers = info["workers"]
+    types = sorted(w["workertype"] for w in workers.values())
+    assert types == ["calc", "download", "movebcolz"]
+    node_names = {w["node"] for w in workers.values()}
+    assert node_names == {cluster["worker"].node_name}
+    assert info["others"] == {}
+    assert cluster["rpc"].last_call_duration is not None
+
+
+def test_groupby_single_file_vs_pandas(cluster, taxi_df):
+    rpc = cluster["rpc"]
+    for op, pandas_fn in [("sum", "sum"), ("mean", "mean"), ("count", "count")]:
+        got = rpc.groupby(
+            ["taxi.bcolz"],
+            ["payment_type"],
+            [["total_amount", op, "total_amount"]],
+            [],
+        )
+        got = got.sort_values("payment_type").reset_index(drop=True)
+        expected = (
+            getattr(taxi_df.groupby("payment_type")["total_amount"], pandas_fn)()
+            .reset_index()
+        )
+        pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_groupby_sharded_matches_full(cluster):
+    rpc = cluster["rpc"]
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    full = rpc.groupby(
+        ["taxi.bcolz"], ["payment_type"],
+        [["passenger_count", "count", "passenger_count"]], [],
+    )
+    parts = rpc.groupby(
+        shard_names, ["payment_type"],
+        [["passenger_count", "count", "passenger_count"]], [],
+    )
+    full = full.sort_values("payment_type").reset_index(drop=True)
+    parts = parts.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(full, parts, check_dtype=False)
+
+
+def test_groupby_with_filter(cluster, taxi_df):
+    got = cluster["rpc"].groupby(
+        ["taxi.bcolz"],
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        [("trip_distance", ">", 5.0)],
+    )
+    expected = (
+        taxi_df[taxi_df.trip_distance > 5.0]
+        .groupby("payment_type")["total_amount"].sum().reset_index()
+    )
+    got = got.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_groupby_unknown_file_errors(cluster):
+    from bqueryd_tpu.rpc import RPCError
+
+    with pytest.raises(RPCError, match="not found"):
+        cluster["rpc"].groupby(["nope.bcolz"], ["payment_type"], [["x", "sum", "x"]], [])
+
+
+def test_unknown_verb_errors(cluster):
+    from bqueryd_tpu.rpc import RPCError
+
+    with pytest.raises(RPCError, match="unknown verb"):
+        cluster["rpc"].frobnicate()
+
+
+def test_sleep_roundtrip(cluster):
+    result = cluster["rpc"].sleep(0.01)
+    assert "slept" in result
+
+
+def test_download_ticket_registration(cluster):
+    import bqueryd_tpu
+
+    rpc = cluster["rpc"]
+    ticket = rpc.download(filenames=["test_download.bcolz"], bucket="bcolz", wait=False)
+    store = cluster["controller"].store
+    entries = store.hgetall(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + ticket)
+    assert len(entries) == 1
+    ((slot, value),) = entries.items()
+    assert slot.partition("_")[2] == "s3://bcolz/test_download.bcolz"
+    assert value.rpartition("_")[2] == "-1"
+
+
+def test_download_wait_released_by_dummy_downloader(cluster):
+    result = cluster["rpc"].download(
+        filenames=["some_file.newdata"], bucket="bcolz", wait=True
+    )
+    assert result == "DONE"
+
+
+def test_worker_error_aborts_query(cluster, data_dir):
+    """A shard whose table is corrupt must abort the whole query with the
+    worker's error forwarded (reference bqueryd/controller.py:157-168)."""
+    import shutil
+
+    from bqueryd_tpu.rpc import RPCError
+
+    from tests.conftest import wait_until
+
+    bad = os.path.join(data_dir, "bad.bcolz")
+    os.makedirs(bad, exist_ok=True)
+    with open(os.path.join(bad, "meta.json"), "w") as f:
+        f.write("{}")
+    try:
+        wait_until(
+            lambda: "bad.bcolz" in cluster["controller"].files_map,
+            desc="bad.bcolz discovery",
+        )
+        with pytest.raises(RPCError):
+            cluster["rpc"].groupby(
+                ["bad.bcolz"], ["payment_type"], [["x", "sum", "x"]], []
+            )
+    finally:
+        shutil.rmtree(bad)
+
+
+def test_loglevel_fanout(cluster):
+    import bqueryd_tpu
+
+    assert cluster["rpc"].loglevel("debug") == "OK"
+    assert bqueryd_tpu.logger.level == logging.DEBUG
+    cluster["rpc"].loglevel("info")
+    assert bqueryd_tpu.logger.level == logging.INFO
