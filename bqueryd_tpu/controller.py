@@ -226,13 +226,19 @@ class ControllerNode:
     # -- scheduling --------------------------------------------------------
     def find_free_worker(self, needs_local=False, filename=None):
         """Random choice among free calc workers, constrained to workers
-        advertising ``filename`` and optionally to this controller's host
-        (reference bqueryd/controller.py:113-144)."""
+        advertising ``filename`` — a single name or, for a batched shard
+        group, a list the worker must advertise in full — and optionally to
+        this controller's host (reference bqueryd/controller.py:113-144)."""
+        needed = (
+            [filename] if isinstance(filename, str) else list(filename or [])
+        )
         candidates = []
         for worker_id, info in self.worker_map.items():
             if info.get("workertype") != "calc" or info.get("busy"):
                 continue
-            if filename and worker_id not in self.files_map.get(filename, ()):
+            if any(
+                worker_id not in self.files_map.get(f, ()) for f in needed
+            ):
                 continue
             if needs_local and info.get("node") != self.node_name:
                 continue
@@ -260,10 +266,56 @@ class ControllerNode:
                 filename=msg.get("filename"),
             )
             if worker_id is None:
+                filename = msg.get("filename")
+                needed = (
+                    [filename]
+                    if isinstance(filename, str)
+                    else list(filename or [])
+                )
+                if needed and any(f not in self.files_map for f in needed):
+                    # the file vanished from every worker (all holders died):
+                    # no future tick can serve this — fail fast instead of
+                    # head-of-line-blocking the queue forever
+                    queue.pop(0)
+                    self.abort_parent(
+                        msg.get("parent_token"),
+                        f"file(s) no longer on any worker: "
+                        f"{[f for f in needed if f not in self.files_map]}",
+                    )
+                elif isinstance(filename, list) and not self._servable_by_one(
+                    filename
+                ):
+                    # placement changed since batching (e.g. the co-locating
+                    # worker died): re-split the group into per-shard
+                    # messages, which the normal scheduler can place
+                    queue.pop(0)
+                    queue.extend(self._split_batch(msg))
                 continue  # retry next tick
             queue.pop(0)
             self._send_to_worker(worker_id, msg)
         self._affinity_rr += 1
+
+    def _servable_by_one(self, filenames):
+        """True if ANY calc worker (busy or not) advertises every file."""
+        sets = [self.files_map.get(f, set()) for f in filenames]
+        common = set.intersection(*sets) if sets else set()
+        return any(
+            self.worker_map.get(w, {}).get("workertype") == "calc"
+            for w in common
+        )
+
+    def _split_batch(self, msg):
+        """Explode a batched shard-group CalcMessage back into per-shard
+        messages (same parent, fresh tokens, retry count carried over)."""
+        args, kwargs = msg.get_args_kwargs()
+        children = []
+        for filename in msg["filename"]:
+            child = CalcMessage(dict(msg))
+            child.set_args_kwargs([filename] + list(args[1:]), kwargs)
+            child["token"] = os.urandom(8).hex()
+            child["filename"] = filename
+            children.append(child)
+        return children
 
     def _send_to_worker(self, worker_id, msg):
         try:
@@ -382,7 +434,7 @@ class ControllerNode:
             self.remove_worker(worker_id)
             return
         if msg.isa(TicketDoneMessage):
-            self.release_ticket_waiters(msg.get("ticket"))
+            self.release_ticket_waiters(msg.get("ticket"), msg.get("error"))
             return
         token = msg.get("token")
         if token:
@@ -409,13 +461,32 @@ class ControllerNode:
             self.abort_parent(parent, msg.get("payload"))
             return
         filename = msg.get("filename")
-        segment["results"][filename] = msg.get("data") or b""
-        segment["timings"][filename] = msg.get("phase_timings")
-        if len(segment["results"]) == len(segment["filenames"]):
+        # a batched shard-group reply covers several filenames with ONE
+        # already-merged payload (the worker's on-device psum merge);
+        # completion is counted in covered filenames, not replies
+        key = tuple(filename) if isinstance(filename, list) else (filename,)
+        segment["results"][key] = msg.get("data") or b""
+        segment["timings"][key] = msg.get("phase_timings")
+        covered = sum(len(k) for k in segment["results"])
+        if covered == len(segment["filenames"]):
             self.rpc_segments.pop(parent)
-            payloads = [segment["results"][f] for f in segment["filenames"]]
+            # payloads in requested-filename order (not reply-arrival order):
+            # the aggregate=False rows path concatenates payloads client-side,
+            # and the reference's row order is deterministic by filename
+            covering = {
+                f: k for k in segment["results"] for f in k
+            }
+            payloads, seen = [], set()
+            for f in segment["filenames"]:
+                k = covering[f]
+                if k not in seen:
+                    seen.add(k)
+                    payloads.append(segment["results"][k])
+            timings = {
+                "/".join(k): v for k, v in segment["timings"].items()
+            }
             reply = pickle.dumps(
-                {"ok": True, "payloads": payloads, "timings": segment["timings"]},
+                {"ok": True, "payloads": payloads, "timings": timings},
                 protocol=4,
             )
             self.reply_rpc_raw(segment["client_token"], reply)
@@ -612,11 +683,15 @@ class ControllerNode:
 
         setup_download(self, msg)
 
-    def release_ticket_waiters(self, ticket):
+    def release_ticket_waiters(self, ticket, error=None):
         segment = self.rpc_segments.pop(f"ticket_{ticket}", None)
         if segment is not None:
-            reply = segment["msg"].copy()
-            reply["payload"] = "DONE"
+            if error:
+                reply = ErrorMessage(segment["msg"])
+                reply["payload"] = f"download ticket {ticket} failed: {error}"
+            else:
+                reply = segment["msg"].copy()
+                reply["payload"] = "DONE"
             reply["ticket"] = ticket
             self.reply_rpc_message(segment["client_token"], reply)
 
@@ -630,6 +705,10 @@ class ControllerNode:
         filenames, groupby_cols, agg_list, where_terms = args
         if isinstance(filenames, str):
             filenames = [filenames]
+        # dedup, order-preserving: duplicates would double-count on the
+        # batched path and deadlock the per-shard path (both replies collapse
+        # onto one result key, so the segment never completes)
+        filenames = list(dict.fromkeys(filenames))
         unknown = [f for f in filenames if f not in self.files_map]
         if unknown:
             raise ValueError(f"filenames not found on any worker: {unknown}")
@@ -644,10 +723,13 @@ class ControllerNode:
             "timings": {},
             "created": time.time(),
         }
-        for filename in filenames:
+        for group in self._shard_groups(
+            filenames, groupby_cols, agg_list, kwargs
+        ):
             shard = CalcMessage({"payload": "groupby"})
+            target = group if len(group) > 1 else group[0]
             shard.set_args_kwargs(
-                [filename, groupby_cols, agg_list, where_terms],
+                [target, groupby_cols, agg_list, where_terms],
                 {
                     k: v
                     for k, v in kwargs.items()
@@ -656,6 +738,36 @@ class ControllerNode:
             )
             shard["token"] = os.urandom(8).hex()
             shard["parent_token"] = parent_token
-            shard["filename"] = filename
+            shard["filename"] = target
             shard["affinity"] = affinity
             self.worker_out_messages.setdefault(affinity, []).append(shard)
+
+    def _shard_groups(self, filenames, groupby_cols, agg_list, kwargs):
+        """Partition the requested shard files into dispatch groups.
+
+        Shards sharing an identical advertising-worker set are batched into
+        ONE CalcMessage so the worker merges them on its device mesh with a
+        psum instead of the controller collecting N serialized partials —
+        the core TPU redesign of the reference's per-shard fan-out
+        (reference bqueryd/controller.py:494-506).  Batching applies only to
+        psum-mergeable aggregations; distinct-count and raw-rows queries
+        keep per-shard dispatch.  ``batch=False`` forces the reference's
+        one-message-per-shard behaviour (finer retry granularity).
+        """
+        from bqueryd_tpu.models.query import MERGEABLE_OPS, GroupByQuery
+
+        probe = GroupByQuery(
+            groupby_cols, agg_list, aggregate=kwargs.get("aggregate", True)
+        )
+        batchable = (
+            kwargs.get("batch", True)
+            and probe.aggregate
+            and all(op in MERGEABLE_OPS for op in probe.ops)
+        )
+        if not batchable:
+            return [[f] for f in filenames]
+        groups = {}
+        for f in filenames:
+            placement = tuple(sorted(self.files_map.get(f, ())))
+            groups.setdefault(placement, []).append(f)
+        return list(groups.values())

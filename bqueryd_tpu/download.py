@@ -30,6 +30,7 @@ from bqueryd_tpu import blob as blob_mod
 from bqueryd_tpu.utils.fs import mkdir_p, rm_file_or_dir
 
 DONE = "DONE"
+ERROR_PREFIX = "ERROR"
 METADATA_FILENAME = "bqueryd.metadata"
 
 
@@ -111,7 +112,10 @@ def check_downloads(worker):
         ticket = key[len(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX):]
         for slot, value in worker.store.hgetall(key).items():
             slot_node, _, fileurl = slot.partition("_")
-            if slot_node != node or slot_state(value) == DONE:
+            state = slot_state(value)
+            if slot_node != node or state == DONE or state.startswith(
+                ERROR_PREFIX
+            ):
                 continue
             lock = worker.store.lock(
                 lock_name(node, ticket, fileurl),
@@ -121,9 +125,9 @@ def check_downloads(worker):
                 continue
             try:
                 worker.download_file(ticket, fileurl)
-            except Exception:
+            except Exception as exc:
                 worker.logger.exception("download %s failed", fileurl)
-                worker.remove_ticket(ticket)
+                worker.fail_ticket(ticket, fileurl, str(exc))
             finally:
                 lock.release()
 
@@ -224,6 +228,33 @@ def remove_ticket(worker, ticket):
     rm_file_or_dir(incoming_dir(worker, ticket))
 
 
+def fail_ticket(worker, ticket, fileurl, error):
+    """Mark a terminally failed download as ERROR in its slot (instead of the
+    reference's slot deletion, reference bqueryd/worker.py:558-567, which made
+    the remaining nodes' all-DONE barrier pass and activate a PARTIAL dataset
+    while the waiting client was told DONE — flagged two-phase-commit fix).
+
+    The ERROR state poisons the ticket: movebcolz never activates it (and
+    cleans its own staging), waiting clients get the error back, and
+    ``delete_download(ticket)`` clears the record."""
+    # the state token must survive slot_state()'s rpartition('_') parsing
+    reason = str(error).replace("_", "-")[:80] or "failed"
+    set_progress(
+        worker.store, worker.node_name, ticket, fileurl,
+        f"{ERROR_PREFIX}:{reason}",
+    )
+    rm_file_or_dir(incoming_dir(worker, ticket))
+
+
+def ticket_error(store, ticket):
+    """First ERROR state recorded on a ticket, or None."""
+    for value in store.hgetall(ticket_key(ticket)).values():
+        state = slot_state(value)
+        if state.startswith(ERROR_PREFIX):
+            return state
+    return None
+
+
 # ---------------------------------------------------------------------------
 # movebcolz side (phase 2 of the commit)
 # ---------------------------------------------------------------------------
@@ -236,7 +267,14 @@ def check_moves(worker):
         entries = worker.store.hgetall(key)
         if not entries:
             continue
-        if not all(slot_state(v) == DONE for v in entries.values()):
+        states = [slot_state(v) for v in entries.values()]
+        if any(s.startswith(ERROR_PREFIX) for s in states):
+            # poisoned ticket: never activate anywhere; drop own staging so
+            # no node serves a partial dataset (the ERROR slot itself stays
+            # visible until delete_download clears it)
+            rm_file_or_dir(incoming_dir(worker, ticket))
+            continue
+        if not all(s == DONE for s in states):
             continue
         staging = incoming_dir(worker, ticket)
         if not os.path.isdir(staging):

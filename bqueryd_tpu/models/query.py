@@ -31,6 +31,25 @@ import numpy as np
 
 PAYLOAD_FORMAT = "bqueryd-tpu-result-1"
 
+#: the bquery aggregation surface (reference bquery API; reference tests
+#: exercise sum/mean/count) plus min/max.  Defined here, JAX-free, so
+#: control-plane processes (controller batching decisions) can consult them;
+#: bqueryd_tpu.ops re-exports.
+AGG_OPS = (
+    "sum",
+    "mean",
+    "count",
+    "count_na",
+    "count_distinct",
+    "sorted_count_distinct",
+    "min",
+    "max",
+)
+
+#: ops whose partials merge with elementwise +/min/max (psum-able); the two
+#: distinct-count ops need value sets and take the gather path instead.
+MERGEABLE_OPS = ("sum", "mean", "count", "count_na", "min", "max")
+
 
 @dataclass
 class GroupByQuery:

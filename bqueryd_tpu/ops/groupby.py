@@ -26,20 +26,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-AGG_OPS = (
-    "sum",
-    "mean",
-    "count",
-    "count_na",
-    "count_distinct",
-    "sorted_count_distinct",
-    "min",
-    "max",
-)
-
-#: ops whose partials merge with elementwise +/min/max (psum-able); the two
-#: distinct-count ops need value sets and take the gather path instead.
-MERGEABLE_OPS = ("sum", "mean", "count", "count_na", "min", "max")
+# canonical definitions live JAX-free in models.query (the controller needs
+# them to decide shard batching without importing jax); re-exported here
+from bqueryd_tpu.models.query import AGG_OPS, MERGEABLE_OPS  # noqa: F401
 
 
 def _accum_dtype(dtype):

@@ -1,0 +1,319 @@
+"""Mesh executor: one query over many shards, merged on-device with psum.
+
+This is the TPU-native replacement for the reference's shard fan-out + merge
+pipeline (per-shard tar results at reference bqueryd/worker.py:335-346,
+controller tar-of-tars at reference bqueryd/controller.py:186-211, client
+re-groupby at reference bqueryd/rpc.py:150-173).  Where the reference ships N
+serialized result tables over TCP and re-aggregates them twice, here the N
+shards are laid out over a 1-D ``jax.sharding.Mesh`` and the merge is a
+``jax.lax.psum`` of index-aligned partial tables riding the ICI — one compiled
+program, zero host serialization between partial and merged result.
+
+What makes the psum legal is host-side key alignment: every shard's group
+codes are remapped into one *global* composite-key space before the kernel
+runs (SURVEY.md §7.3 "Merge alignment"), so row ``g`` of every device's
+partial table refers to the same group.  The alignment is cheap (NumPy
+searchsorted over per-shard dictionaries, not data rows) and happens once per
+query.
+
+Layout: shards are packed greedily onto the mesh's devices (longest shard to
+least-loaded device), per-device rows concatenated and right-padded with
+code ``-1`` (the null code — padding therefore contributes to no group, see
+``ops.partial_tables``), giving a static ``[n_devices, rows_per_device]``
+shape XLA can tile.
+
+Falls back to nothing: callers (worker, __graft_entry__, bench) route
+non-mergeable aggregations (count_distinct family) and the aggregate=False
+raw-rows path through the per-shard ``QueryEngine`` + host merge instead —
+those results carry value *sets*, which a fixed-width psum cannot merge.
+"""
+
+import functools
+
+import numpy as np
+
+from bqueryd_tpu.models.query import GroupByQuery, ResultPayload
+
+
+def make_mesh(n_devices=None, axis_name="shards"):
+    """A 1-D mesh over the first ``n_devices`` local JAX devices."""
+    import jax
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+class MeshQueryExecutor:
+    """Executes a :class:`GroupByQuery` over a list of shard tables on a
+    device mesh, merging per-shard partials with ``ops.psum_partials``.
+
+    Handles the mergeable aggregation set (``ops.MERGEABLE_OPS``); the worker
+    falls back to per-shard execution for distinct-count ops and raw rows.
+    """
+
+    def __init__(self, mesh=None, axis_name="shards", timer=None):
+        self._mesh = mesh
+        self.axis_name = axis_name
+        self.timer = timer
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh(axis_name=self.axis_name)
+        return self._mesh
+
+    def _phase(self, name):
+        import contextlib
+
+        if self.timer is None:
+            return contextlib.nullcontext()
+        return self.timer.phase(name)
+
+    @staticmethod
+    def supports(query: GroupByQuery):
+        from bqueryd_tpu import ops
+
+        return query.aggregate and all(
+            op in ops.MERGEABLE_OPS for op in query.ops
+        )
+
+    # -- key alignment (host-side, dictionary-sized work only) --------------
+    def _global_key_space(self, tables, query, engine):
+        """Remap every shard's per-column key codes into one global space.
+
+        Returns ``(per_shard_packed, combos, cards, key_values)`` where
+        ``combos`` is the sorted global composite-key array, ``cards`` the
+        global per-column cardinalities, and ``key_values[col]`` the global
+        per-column key-value arrays (indexable by unpacked codes).
+        """
+        n_cols = len(query.groupby_cols)
+        shard_codes = [[] for _ in range(n_cols)]   # [col][shard] -> codes
+        shard_values = [[] for _ in range(n_cols)]  # [col][shard] -> uniques
+        for table in tables:
+            for ci, col in enumerate(query.groupby_cols):
+                codes, values = engine._key_codes(table, col)
+                shard_codes[ci].append(np.asarray(codes))
+                shard_values[ci].append(np.asarray(values))
+
+        cards = []
+        global_values = []
+        global_codes = [[] for _ in range(len(tables))]  # [shard][col]
+        for ci in range(n_cols):
+            allv = np.concatenate(shard_values[ci])
+            gvals = np.unique(allv)
+            cards.append(max(len(gvals), 1))
+            global_values.append(gvals)
+            for si in range(len(tables)):
+                # local dictionary -> global position, gathered through the
+                # local codes; null codes (<0) stay null
+                pos = np.searchsorted(gvals, shard_values[ci][si])
+                codes = shard_codes[ci][si]
+                mapped = np.where(
+                    codes >= 0, pos[np.clip(codes, 0, None)], np.int64(-1)
+                )
+                global_codes[si].append(mapped)
+
+        from bqueryd_tpu import ops
+
+        per_shard_packed = []
+        for si in range(len(tables)):
+            if n_cols == 1:
+                packed = global_codes[si][0].astype(np.int64)
+            else:
+                packed = ops.pack_codes(global_codes[si], cards)
+            per_shard_packed.append(packed)
+
+        observed = [p[p >= 0] for p in per_shard_packed]
+        observed = [o for o in observed if len(o)]
+        combos = (
+            np.unique(np.concatenate(observed))
+            if observed
+            else np.empty(0, dtype=np.int64)
+        )
+        # dense codes: position of each packed composite in the sorted combos
+        dense = []
+        for packed in per_shard_packed:
+            pos = np.searchsorted(combos, np.clip(packed, 0, None))
+            dense.append(np.where(packed >= 0, pos, np.int64(-1)))
+        key_values = dict(zip(query.groupby_cols, global_values))
+        return dense, combos, cards, key_values
+
+    # -- device layout ------------------------------------------------------
+    def _bucketize(self, arrays_per_shard, n_devices, pad_values):
+        """Greedy-pack shards onto devices; concat + right-pad each bucket.
+
+        ``arrays_per_shard``: list (per shard) of tuples of 1-D arrays, all
+        the same length within a shard.  Returns a tuple of stacked
+        ``[n_devices, L]`` arrays.
+        """
+        order = sorted(
+            range(len(arrays_per_shard)),
+            key=lambda i: -len(arrays_per_shard[i][0]),
+        )
+        buckets = [[] for _ in range(n_devices)]
+        loads = [0] * n_devices
+        for si in order:
+            d = loads.index(min(loads))
+            buckets[d].append(si)
+            loads[d] += len(arrays_per_shard[si][0])
+        width = max(max(loads), 1)
+
+        n_arrays = len(arrays_per_shard[0])
+        stacked = []
+        for ai in range(n_arrays):
+            sample = arrays_per_shard[0][ai]
+            out = np.full(
+                (n_devices, width), pad_values[ai], dtype=sample.dtype
+            )
+            for d, members in enumerate(buckets):
+                off = 0
+                for si in members:
+                    arr = arrays_per_shard[si][ai]
+                    out[d, off : off + len(arr)] = arr
+                    off += len(arr)
+            stacked.append(out)
+        return stacked
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, tables, query: GroupByQuery) -> ResultPayload:
+        from bqueryd_tpu import ops
+        from bqueryd_tpu.models.query import QueryEngine
+
+        if not self.supports(query):
+            raise ValueError(
+                "MeshQueryExecutor handles mergeable aggregations only; "
+                "route distinct-count / raw-rows queries per shard"
+            )
+        engine = QueryEngine()
+
+        with self._phase("prune"):
+            tables = [
+                t
+                for t in tables
+                if not query.where_terms
+                or ops.shard_can_match(t, query.where_terms)
+            ]
+        if not tables:
+            return ResultPayload.empty()
+
+        with self._phase("mask"):
+            masks = []
+            for table in tables:
+                mask = ops.build_mask(table, query.where_terms)
+                if query.expand_filter_column:
+                    basket_raw = table.column_raw(query.expand_filter_column)
+                    bcodes, buniques = ops.factorize(basket_raw)
+                    mask = ops.expand_mask_by_group(
+                        bcodes, mask, n_groups=len(buniques)
+                    )
+                masks.append(None if mask is None else np.asarray(mask))
+
+        with self._phase("align"):
+            dense, combos, cards, key_values = self._global_key_space(
+                tables, query, engine
+            )
+            n_groups = max(len(combos), 1)
+            # fold the row mask into the codes: masked-out rows become null
+            # (code -1) and vanish from every segment reduction
+            for si, mask in enumerate(masks):
+                if mask is not None:
+                    dense[si] = np.where(mask, dense[si], np.int64(-1))
+
+        with self._phase("layout"):
+            n_dev = self.mesh.devices.size
+            measure_cols = query.in_cols
+            per_shard = []
+            for si, table in enumerate(tables):
+                arrs = [dense[si].astype(np.int32)]
+                for col in measure_cols:
+                    arrs.append(np.asarray(table.column_raw(col)))
+                per_shard.append(tuple(arrs))
+            pads = [np.int32(-1)] + [0] * len(measure_cols)
+            stacked = self._bucketize(per_shard, n_dev, pads)
+
+        with self._phase("aggregate"):
+            merged = self._run_mesh(
+                stacked[0], tuple(stacked[1:]), query.ops, n_groups
+            )
+            merged = {
+                "rows": np.asarray(merged["rows"]),
+                "aggs": [
+                    {k: np.asarray(v) for k, v in part.items()}
+                    for part in merged["aggs"]
+                ],
+            }
+
+        with self._phase("collect"):
+            rows = merged["rows"]
+            present = rows > 0
+            combos_present = combos[present]
+            if len(query.groupby_cols) == 1:
+                key_codes = [combos_present]
+            else:
+                key_codes = ops.unpack_codes(combos_present, cards)
+            keys = {}
+            for col, codes_g in zip(query.groupby_cols, key_codes):
+                idx = np.asarray(codes_g, dtype=np.int64)
+                keys[col] = key_values[col][idx]
+            aggs = [
+                {k: v[present] for k, v in part.items()}
+                for part in merged["aggs"]
+            ]
+            return ResultPayload.partials(
+                key_cols=query.groupby_cols,
+                keys=keys,
+                rows=rows[present],
+                aggs=aggs,
+                ops=query.ops,
+                out_cols=query.out_cols,
+            )
+
+    def _run_mesh(self, codes, measures, agg_ops, n_groups):
+        """Place ``[n_dev, L]`` blocks over the mesh and run the compiled
+        partials + psum program; result is replicated, one copy pulled."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        axis = self.axis_name
+        sharding = NamedSharding(mesh, P(axis, None))
+        codes_d = jax.device_put(codes, sharding)
+        measures_d = tuple(jax.device_put(m, sharding) for m in measures)
+        return _mesh_partials(
+            mesh, axis, agg_ops, n_groups, codes_d, measures_d
+        )
+
+
+@functools.lru_cache(maxsize=64)
+def _mesh_program(mesh, axis, agg_ops, n_groups, n_measures):
+    """Build + cache the jitted shard_map program for one query shape."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bqueryd_tpu import ops
+
+    def block_fn(codes_blk, *measure_blks):
+        partials = ops.partial_tables(
+            codes_blk[0],
+            tuple(m[0] for m in measure_blks),
+            agg_ops,
+            n_groups,
+        )
+        return ops.psum_partials(partials, axis)
+
+    fn = jax.shard_map(
+        block_fn,
+        mesh=mesh,
+        in_specs=tuple([P(axis, None)] * (1 + n_measures)),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d):
+    program = _mesh_program(
+        mesh, axis, tuple(agg_ops), int(n_groups), len(measures_d)
+    )
+    return program(codes_d, *measures_d)

@@ -108,6 +108,10 @@ class RPC:
 
     def _rpc(self, name, args, kwargs):
         started = time.time()
+        if name == "groupby" and self.legacy_merge:
+            # the sum-of-shard-means quirk needs per-shard payloads: disable
+            # the controller's batched (pre-merged) shard-group dispatch
+            kwargs.setdefault("batch", False)
         msg = RPCMessage({"payload": name})
         msg.set_args_kwargs(list(args), kwargs)
         wire = msg.to_json().encode()

@@ -314,6 +314,7 @@ class WorkerNode(WorkerBase):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._engine = None
+        self._mesh_executor = None
 
     @property
     def engine(self):
@@ -322,6 +323,36 @@ class WorkerNode(WorkerBase):
 
             self._engine = QueryEngine()
         return self._engine
+
+    @property
+    def mesh_executor(self):
+        if self._mesh_executor is None:
+            from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+
+            self._mesh_executor = MeshQueryExecutor()
+        return self._mesh_executor
+
+    def _execute(self, tables, query, timer):
+        """One shard -> single-device engine; a batched shard group with
+        psum-mergeable aggregations -> mesh executor (on-device merge); any
+        other multi-shard shape -> per-shard engine + host value-keyed merge.
+        Always returns ONE payload per CalcMessage."""
+        from bqueryd_tpu.parallel import hostmerge
+        from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+
+        if len(tables) == 1:
+            self.engine.timer = timer
+            return self.engine.execute_local(tables[0], query)
+        if MeshQueryExecutor.supports(query):
+            self.mesh_executor.timer = timer
+            return self.mesh_executor.execute(tables, query)
+        self.engine.timer = timer
+        payloads = [self.engine.execute_local(t, query) for t in tables]
+        with timer.phase("hostmerge"):
+            merged = hostmerge.merge_payloads(payloads)
+        from bqueryd_tpu.models.query import ResultPayload
+
+        return ResultPayload(merged)
 
     def handle_work(self, msg):
         if msg.isa("execute_code"):
@@ -342,17 +373,24 @@ class WorkerNode(WorkerBase):
             aggregate=kwargs.get("aggregate", True),
             expand_filter_column=kwargs.get("expand_filter_column"),
         )
-        rootdir = os.path.join(self.data_dir, filename)
-        if not os.path.exists(rootdir):
-            raise ValueError(f"Path {rootdir} does not exist")
+        filenames = filename if isinstance(filename, list) else [filename]
+        tables = []
         with timer.phase("open"):
-            table = ctable(rootdir, mode="r", auto_cache=True)
-        self.engine.timer = timer
-        payload = self.engine.execute_local(table, query)
+            for name in filenames:
+                rootdir = os.path.join(self.data_dir, name)
+                if not os.path.exists(rootdir):
+                    raise ValueError(f"Path {rootdir} does not exist")
+                tables.append(ctable(rootdir, mode="r", auto_cache=True))
+        payload = self._execute(tables, query, timer)
         with timer.phase("serialize"):
             data = payload.to_bytes()
-        if self.memory_limit_mb and sys.getsizeof(data) > 64 * 1024 * 1024:
-            free_cachemem()  # large raw-rows result: drop column cache early
+        # a result comparable to the worker's memory budget (1/32 of the
+        # restart limit, 64 MB at the default 2 GB) means the column cache is
+        # the next thing to evict
+        if self.memory_limit_mb and sys.getsizeof(data) > (
+            self.memory_limit_mb * (1 << 20) // 32
+        ):
+            free_cachemem()
         reply = msg.copy()
         reply["data"] = data
         reply["phase_timings"] = timer.as_dict()
@@ -422,6 +460,17 @@ class DownloaderNode(WorkerBase):
 
         remove_ticket(self, ticket)
         self.send_to_all(TicketDoneMessage({"ticket": ticket}))
+
+    def fail_ticket(self, ticket, fileurl, error):
+        """Terminal download failure: poison the ticket (ERROR slot blocks
+        activation on every node) and tell controllers so waiting clients get
+        the error instead of the reference's false DONE."""
+        from bqueryd_tpu.download import fail_ticket
+
+        fail_ticket(self, ticket, fileurl, error)
+        self.send_to_all(
+            TicketDoneMessage({"ticket": ticket, "error": str(error)})
+        )
 
 
 class MoveBcolzNode(DownloaderNode):

@@ -1,0 +1,235 @@
+"""Mesh executor: multi-shard queries merged on a virtual 8-device CPU mesh.
+
+Covers SURVEY.md §7.2 step 5 (shard fan-out + mesh merge): equivalence of the
+psum-merged result against both pandas ground truth and the per-shard
+QueryEngine + host-merge path, for single/multi key, filters, string keys,
+and shard counts above/below the device count.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.models.query import GroupByQuery, QueryEngine, ResultPayload
+from bqueryd_tpu.parallel import hostmerge
+from bqueryd_tpu.parallel.executor import MeshQueryExecutor, make_mesh
+from bqueryd_tpu.storage import ctable
+
+N_SHARDS = 5
+
+
+def taxi_like_df(n=12_000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "VendorID": rng.integers(1, 3, n).astype(np.int64),
+            "passenger_count": rng.integers(0, 7, n).astype(np.int64),
+            "payment_type": rng.integers(1, 5, n).astype(np.int64),
+            "trip_distance": rng.exponential(3.0, n),
+            "fare_amount": rng.gamma(2.0, 7.0, n),
+            "flag": rng.choice(["Y", "N", "M"], n),
+            "PULocationID": rng.integers(1, 266, n).astype(np.int64),
+            "DOLocationID": rng.integers(1, 266, n).astype(np.int64),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def sharded(tmp_path_factory):
+    """Unevenly sized shards (so bucket packing + padding is exercised)."""
+    df = taxi_like_df()
+    base = tmp_path_factory.mktemp("mesh")
+    cuts = np.array([0, 1_000, 4_200, 6_000, 9_500, len(df)])
+    tables = []
+    for i in range(N_SHARDS):
+        part = df.iloc[cuts[i] : cuts[i + 1]].reset_index(drop=True)
+        root = str(base / f"taxi_{i}.bcolzs")
+        ctable.fromdataframe(part, root)
+        tables.append(ctable(root, mode="r"))
+    return df, tables
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()  # all 8 virtual CPU devices
+
+
+def mesh_result(tables, *args, **kw):
+    query = GroupByQuery(*args, **kw)
+    payload = MeshQueryExecutor(mesh=make_mesh()).execute(tables, query)
+    wire = ResultPayload.from_bytes(payload.to_bytes())
+    return hostmerge.payload_to_dataframe(hostmerge.merge_payloads([wire]))
+
+
+def pershard_result(tables, *args, **kw):
+    query = GroupByQuery(*args, **kw)
+    engine = QueryEngine()
+    payloads = [engine.execute_local(t, query) for t in tables]
+    return hostmerge.payload_to_dataframe(hostmerge.merge_payloads(payloads))
+
+
+def assert_frames_match(got, expected, key_cols, **kw):
+    got = got.sort_values(key_cols).reset_index(drop=True)
+    expected = expected.sort_values(key_cols).reset_index(drop=True)
+    expected = expected[list(got.columns)]
+    pd.testing.assert_frame_equal(
+        got, expected, check_dtype=False, check_index_type=False, **kw
+    )
+
+
+def test_mesh_uses_all_devices(mesh):
+    assert mesh.devices.size == 8
+
+
+def test_single_key_sum_matches_pandas(sharded, mesh):
+    df, tables = sharded
+    got = mesh_result(
+        tables, ["passenger_count"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    expected = df.groupby("passenger_count")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["passenger_count"])
+
+
+def test_int64_sum_bit_exact(sharded, mesh):
+    """North-star bit-for-bit int64: sums of int64 columns across the psum
+    merge equal pandas exactly (no tolerance)."""
+    df, tables = sharded
+    got = mesh_result(
+        tables, ["VendorID"], [["passenger_count", "sum", "s"]]
+    ).sort_values("VendorID").reset_index(drop=True)
+    expected = (
+        df.groupby("VendorID")["passenger_count"].sum().reset_index(name="s")
+    )
+    assert got["s"].dtype == np.int64
+    assert (got["s"].to_numpy() == expected["s"].to_numpy()).all()
+
+
+def test_multi_key_multi_agg(sharded, mesh):
+    df, tables = sharded
+    args = (
+        ["VendorID", "payment_type"],
+        [
+            ["fare_amount", "sum", "fare_sum"],
+            ["fare_amount", "mean", "fare_mean"],
+            ["trip_distance", "max", "dist_max"],
+            ["passenger_count", "count", "n"],
+        ],
+    )
+    got = mesh_result(tables, *args)
+    g = df.groupby(["VendorID", "payment_type"])
+    expected = pd.DataFrame(
+        {
+            "fare_sum": g["fare_amount"].sum(),
+            "fare_mean": g["fare_amount"].mean(),
+            "dist_max": g["trip_distance"].max(),
+            "n": g["passenger_count"].count(),
+        }
+    ).reset_index()
+    assert_frames_match(got, expected, ["VendorID", "payment_type"])
+
+
+def test_string_key_across_shard_dictionaries(sharded, mesh):
+    """Dict-encoded key columns have *different* per-shard dictionaries;
+    alignment must merge by value, not by local code."""
+    df, tables = sharded
+    got = mesh_result(tables, ["flag"], [["fare_amount", "sum", "fare_amount"]])
+    expected = df.groupby("flag")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["flag"])
+
+
+def test_where_filter_pushdown(sharded, mesh):
+    df, tables = sharded
+    where = [["trip_distance", ">", 2.0], ["payment_type", "!=", 1]]
+    got = mesh_result(
+        tables,
+        ["payment_type"],
+        [["fare_amount", "sum", "fare_amount"]],
+        where,
+    )
+    sel = df[(df.trip_distance > 2.0) & (df.payment_type != 1)]
+    expected = sel.groupby("payment_type")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_high_cardinality_two_key(sharded, mesh):
+    """The BASELINE.json stress config: PULocationID x DOLocationID."""
+    df, tables = sharded
+    got = mesh_result(
+        tables,
+        ["PULocationID", "DOLocationID"],
+        [["fare_amount", "sum", "fare_amount"]],
+    )
+    expected = (
+        df.groupby(["PULocationID", "DOLocationID"])["fare_amount"]
+        .sum()
+        .reset_index()
+    )
+    assert_frames_match(got, expected, ["PULocationID", "DOLocationID"])
+
+
+def test_matches_pershard_hostmerge_path(sharded, mesh):
+    """Device psum merge and host value-keyed merge are the same function."""
+    df, tables = sharded
+    args = (
+        ["payment_type"],
+        [["fare_amount", "mean", "m"], ["fare_amount", "min", "lo"]],
+    )
+    got = mesh_result(tables, *args)
+    expected = pershard_result(tables, *args)
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_fewer_shards_than_devices(sharded, mesh):
+    df, tables = sharded
+    got = mesh_result(
+        tables[:2], ["VendorID"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    expected = (
+        pd.concat([t.todataframe() for t in tables[:2]])
+        .groupby("VendorID")["fare_amount"]
+        .sum()
+        .reset_index()
+    )
+    assert_frames_match(got, expected, ["VendorID"])
+
+
+def test_more_shards_than_devices(tmp_path, mesh):
+    df = taxi_like_df(n=3_000, seed=11)
+    bounds = np.linspace(0, len(df), 14, dtype=int)  # 13 shards > 8 devices
+    parts = [df.iloc[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+    tables = []
+    for i, part in enumerate(parts):
+        root = str(tmp_path / f"s{i}.bcolzs")
+        ctable.fromdataframe(part.reset_index(drop=True), root)
+        tables.append(ctable(root, mode="r"))
+    got = mesh_result(
+        tables, ["payment_type"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    expected = df.groupby("payment_type")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_prunes_unmatchable_shards_to_empty(sharded, mesh):
+    _df, tables = sharded
+    payload = MeshQueryExecutor(mesh=mesh).execute(
+        tables,
+        GroupByQuery(
+            ["VendorID"],
+            [["fare_amount", "sum", "s"]],
+            [["trip_distance", ">", 1e9]],
+        ),
+    )
+    # min/max pruning drops every shard before any device work
+    assert payload["kind"] == "empty"
+
+
+def test_rejects_non_mergeable_ops(sharded, mesh):
+    _df, tables = sharded
+    with pytest.raises(ValueError, match="mergeable"):
+        MeshQueryExecutor(mesh=mesh).execute(
+            tables,
+            GroupByQuery(["VendorID"], [["payment_type", "count_distinct", "d"]]),
+        )
+    assert not MeshQueryExecutor.supports(
+        GroupByQuery(["VendorID"], [["fare_amount", "sum", "s"]], aggregate=False)
+    )

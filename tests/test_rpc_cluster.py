@@ -269,3 +269,57 @@ def test_loglevel_fanout(cluster):
     assert bqueryd_tpu.logger.level == logging.DEBUG
     cluster["rpc"].loglevel("info")
     assert bqueryd_tpu.logger.level == logging.INFO
+
+
+def test_batched_dispatch_merges_on_worker(cluster, taxi_df):
+    """Co-located mergeable shards travel as ONE CalcMessage and come back as
+    ONE psum-merged payload (the TPU redesign of per-shard fan-out)."""
+    rpc = cluster["rpc"]
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    got = rpc.groupby(
+        shard_names, ["payment_type"],
+        [["total_amount", "mean", "m"], ["total_amount", "sum", "s"]], [],
+    )
+    # one timing entry covering all shards == one worker round-trip
+    assert len(rpc.last_call_timings) == 1
+    (key,) = rpc.last_call_timings
+    assert sorted(key.split("/")) == sorted(shard_names)
+    g = taxi_df.groupby("payment_type")["total_amount"]
+    expected = pd.DataFrame({"m": g.mean(), "s": g.sum()}).reset_index()
+    got = got.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_batch_false_restores_pershard_dispatch(cluster):
+    rpc = cluster["rpc"]
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    rpc.groupby(
+        shard_names, ["payment_type"], [["total_amount", "sum", "s"]], [],
+        batch=False,
+    )
+    assert len(rpc.last_call_timings) == NR_SHARDS
+
+
+def test_legacy_merge_sum_of_shard_means(cluster, taxi_df):
+    """legacy_merge reproduces the reference's sum-of-shard-means quirk
+    (reference bqueryd/rpc.py:171), which requires per-shard payloads."""
+    from bqueryd_tpu.rpc import RPC
+
+    legacy = RPC(
+        coordination_url=cluster["url"], timeout=60,
+        loglevel=logging.WARNING, legacy_merge=True,
+    )
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    got = legacy.groupby(
+        shard_names, ["payment_type"], [["total_amount", "mean", "m"]], [],
+    )
+    assert len(legacy.last_call_timings) == NR_SHARDS  # batching disabled
+    expected = sum(
+        taxi_df.iloc[i::NR_SHARDS].groupby("payment_type")["total_amount"]
+        .mean()
+        for i in range(NR_SHARDS)
+    ).reset_index(name="m")
+    got = got.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, expected.rename(columns={"total_amount": "m"}), check_dtype=False
+    )

@@ -1,0 +1,189 @@
+"""Controller scheduling edge cases: batched-group re-split when placement
+changes, fail-fast on vanished files, duplicate-filename dedup, and the
+download-failure path of the two-phase commit."""
+
+import logging
+import os
+import time
+
+import pytest
+
+import bqueryd_tpu
+from bqueryd_tpu.controller import ControllerNode
+from bqueryd_tpu.messages import RPCMessage
+
+
+@pytest.fixture
+def controller(tmp_path):
+    node = ControllerNode(
+        coordination_url=f"mem://sched-{os.urandom(4).hex()}",
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+    )
+    yield node
+    node.socket.close()
+
+
+def register(controller, worker_id, files, busy=True):
+    controller.worker_map[worker_id] = {
+        "worker_id": worker_id,
+        "workertype": "calc",
+        "busy": busy,
+        "last_seen": time.time(),
+        "node": controller.node_name,
+    }
+    for f in files:
+        controller.files_map.setdefault(f, set()).add(worker_id)
+
+
+def enqueue_groupby(controller, filenames):
+    msg = RPCMessage({"payload": "groupby", "token": "00"})
+    msg.set_args_kwargs(
+        [filenames, ["k"], [["v", "sum", "v"]], []], {}
+    )
+    controller.rpc_groupby(msg)
+    return msg
+
+
+def queued(controller):
+    return [m for q in controller.worker_out_messages.values() for m in q]
+
+
+def test_colocated_shards_batch_into_one_message(controller):
+    register(controller, "w1", ["a.bcolzs", "b.bcolzs", "c.bcolzs"])
+    enqueue_groupby(controller, ["a.bcolzs", "b.bcolzs", "c.bcolzs"])
+    msgs = queued(controller)
+    assert len(msgs) == 1
+    assert msgs[0]["filename"] == ["a.bcolzs", "b.bcolzs", "c.bcolzs"]
+
+
+def test_split_placement_batches_per_worker_set(controller):
+    register(controller, "w1", ["a.bcolzs", "b.bcolzs"])
+    register(controller, "w2", ["c.bcolzs"])
+    enqueue_groupby(controller, ["a.bcolzs", "b.bcolzs", "c.bcolzs"])
+    names = sorted(
+        str(m["filename"]) for m in queued(controller)
+    )
+    assert names == ["['a.bcolzs', 'b.bcolzs']", "c.bcolzs"]
+
+
+def test_duplicate_filenames_deduplicated(controller):
+    register(controller, "w1", ["a.bcolzs"])
+    enqueue_groupby(controller, ["a.bcolzs", "a.bcolzs", "a.bcolzs"])
+    (msg,) = queued(controller)
+    assert msg["filename"] == "a.bcolzs"
+    (segment,) = controller.rpc_segments.values()
+    assert segment["filenames"] == ["a.bcolzs"]
+
+
+def test_unservable_batch_resplits_to_per_shard(controller):
+    register(controller, "w1", ["a.bcolzs", "b.bcolzs"])
+    enqueue_groupby(controller, ["a.bcolzs", "b.bcolzs"])
+    # placement changes: the co-locating worker dies, two new (busy) workers
+    # each hold one shard
+    controller.remove_worker("w1")
+    register(controller, "w2", ["a.bcolzs"], busy=True)
+    register(controller, "w3", ["b.bcolzs"], busy=True)
+    controller.dispatch_pending()
+    msgs = queued(controller)
+    assert sorted(m["filename"] for m in msgs) == ["a.bcolzs", "b.bcolzs"]
+    parent_tokens = {m["parent_token"] for m in msgs}
+    assert len(parent_tokens) == 1  # still the same query
+    assert len({m["token"] for m in msgs}) == 2  # fresh per-shard tokens
+
+
+def test_vanished_file_aborts_parent_fast(controller):
+    register(controller, "w1", ["a.bcolzs"])
+    enqueue_groupby(controller, ["a.bcolzs"])
+    controller.remove_worker("w1")  # file gone from every worker
+    assert controller.rpc_segments
+    controller.dispatch_pending()
+    assert not queued(controller)
+    assert not controller.rpc_segments  # aborted, client answered
+
+
+def test_batch_respects_non_mergeable_ops(controller):
+    register(controller, "w1", ["a.bcolzs", "b.bcolzs"])
+    msg = RPCMessage({"payload": "groupby", "token": "00"})
+    msg.set_args_kwargs(
+        [["a.bcolzs", "b.bcolzs"], ["k"], [["v", "count_distinct", "v"]], []],
+        {},
+    )
+    controller.rpc_groupby(msg)
+    assert sorted(m["filename"] for m in queued(controller)) == [
+        "a.bcolzs", "b.bcolzs",
+    ]
+
+
+# -- download failure path --------------------------------------------------
+
+
+class _Worker:
+    """Minimal downloader-shaped stand-in for download.py functions."""
+
+    def __init__(self, store, data_dir):
+        self.store = store
+        self.data_dir = data_dir
+        self.node_name = "testnode"
+        self.failed = []
+        import logging as _l
+
+        self.logger = _l.getLogger("test.download")
+
+    def download_file(self, ticket, fileurl):
+        raise IOError("bucket on fire")
+
+    def fail_ticket(self, ticket, fileurl, error):
+        from bqueryd_tpu import download
+
+        download.fail_ticket(self, ticket, fileurl, error)
+        self.failed.append((ticket, fileurl, error))
+
+
+def test_failed_download_poisons_ticket(tmp_path, mem_store_url):
+    from bqueryd_tpu import download
+    from bqueryd_tpu.coordination import coordination_store
+
+    store = coordination_store(mem_store_url)
+    worker = _Worker(store, str(tmp_path))
+    ticket = "t1"
+    download.set_progress(store, "testnode", ticket, "s3://b/f.zip", -1)
+    download.set_progress(store, "othernode", ticket, "s3://b/f.zip", "DONE")
+
+    download.check_downloads(worker)
+    assert worker.failed and worker.failed[0][0] == ticket
+    err = download.ticket_error(store, ticket)
+    assert err and err.startswith("ERROR")
+    # slots survive (observable state), underscore-free reason parses cleanly
+    assert "_" not in err.partition(":")[2]
+
+    # movebcolz must NOT activate and must clear its staging
+    staging = download.incoming_dir(worker, ticket)
+    os.makedirs(os.path.join(staging, "f.bcolz"), exist_ok=True)
+    download.check_moves(worker)
+    assert not os.path.exists(staging)
+    assert not os.path.exists(os.path.join(worker.data_dir, "f.bcolz"))
+
+    # a second poll cycle skips the ERROR slot instead of retrying forever
+    worker.failed.clear()
+    download.check_downloads(worker)
+    assert not worker.failed
+
+
+def test_ticket_error_released_to_waiting_client(controller):
+    """TicketDoneMessage with an error must answer wait=True clients with the
+    failure, not DONE."""
+    from bqueryd_tpu.messages import TicketDoneMessage
+
+    controller.rpc_segments["ticket_t9"] = {
+        "client_token": "00",
+        "msg": RPCMessage({"payload": "download", "token": "00"}),
+        "created": time.time(),
+    }
+    sent = []
+    controller.reply_rpc_message = lambda tok, m: sent.append((tok, m))
+    controller.release_ticket_waiters("t9", "bucket on fire")
+    ((_tok, reply),) = sent
+    assert reply["msg_type"] == "error"
+    assert "bucket on fire" in reply["payload"]
+    assert "ticket_t9" not in controller.rpc_segments
