@@ -1,0 +1,237 @@
+"""Benchmark: the full framework vs the reference architecture, end to end.
+
+Implements BASELINE.md config 2 (the headline): a 10 M-row NYC-taxi-shaped
+dataset in 10 ``.bcolzs`` shards, ``groupby passenger_count ->
+sum(fare_amount)`` (int64 cents, bit-exact), measured through the REAL stack:
+zmq RPC client -> controller -> calc worker -> mesh executor (shard_map
+segment partials + psum merge) -> reply.
+
+``vs_baseline`` is speedup over a faithful CPU re-creation of the reference's
+dataflow (the reference publishes no numbers, SURVEY.md §6, so its
+architecture is the baseline): per shard, decode the columns single-threaded
+(the reference pins Blosc to 1 thread, reference bqueryd/worker.py:40, and
+bcolz decompresses per query — no decoded-row cache), aggregate with pandas
+(the reference's own ground truth, reference tests/test_simple_rpc.py:139-172;
+bquery's Cython kernels are the same class of C loop), tar the per-shard
+result (reference bqueryd/worker.py:335-346), tar-of-tars at the controller
+(reference bqueryd/controller.py:186-211), then untar + concat + re-groupby
+client-side (reference bqueryd/rpc.py:150-173).
+
+Prints ONE JSON line: {"metric", "value" (rows/s through the framework),
+"unit", "vs_baseline"}.
+
+Env knobs: BENCH_ROWS (default 10_000_000), BENCH_SHARDS (10),
+BENCH_REPEATS (3), BENCH_DATA_DIR (default /tmp/bqueryd_tpu_bench).
+"""
+
+import io
+import json
+import logging
+import os
+import pickle
+import sys
+import tarfile
+import threading
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 10_000_000))
+SHARDS = int(os.environ.get("BENCH_SHARDS", 10))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+DATA_DIR = os.environ.get("BENCH_DATA_DIR", "/tmp/bqueryd_tpu_bench")
+
+GROUP_COL = "passenger_count"
+MEASURE_COL = "fare_amount"
+
+
+def build_dataset():
+    """Write the sharded taxi-like dataset once; reuse across runs."""
+    from bqueryd_tpu.storage.ctable import ctable
+
+    stamp = os.path.join(DATA_DIR, f"ready_{ROWS}_{SHARDS}")
+    names = [f"taxi_{i}.bcolzs" for i in range(SHARDS)]
+    if not os.path.exists(stamp):
+        import shutil
+
+        import pandas as pd
+
+        shutil.rmtree(DATA_DIR, ignore_errors=True)
+        os.makedirs(DATA_DIR, exist_ok=True)
+        rng = np.random.RandomState(42)
+        per = ROWS // SHARDS
+        for i, name in enumerate(names):
+            rows = per + (ROWS % SHARDS if i == SHARDS - 1 else 0)
+            df = pd.DataFrame(
+                {
+                    GROUP_COL: rng.randint(1, 10, rows).astype(np.int64),
+                    # integer cents: int64 end-to-end, the north-star
+                    # bit-exactness axis
+                    MEASURE_COL: rng.randint(250, 20000, rows).astype(
+                        np.int64
+                    ),
+                    "trip_distance": (rng.random(rows) * 30).astype(
+                        np.float32
+                    ),
+                }
+            )
+            ctable.fromdataframe(df, os.path.join(DATA_DIR, name))
+        open(stamp, "w").close()
+    return names
+
+
+def start_cluster():
+    """Controller + one calc worker in-process (threads as nodes, the
+    reference's own benchmark/test topology) over real zmq sockets."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import WorkerNode
+
+    url = f"mem://bench-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=DATA_DIR,
+        heartbeat_interval=0.2,
+    )
+    worker = WorkerNode(
+        coordination_url=url,
+        data_dir=DATA_DIR,
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.2,
+        poll_timeout=0.1,
+    )
+    threads = [
+        threading.Thread(target=node.go, daemon=True)
+        for node in (controller, worker)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if len(controller.files_map) >= SHARDS:
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("worker never registered its shards")
+    rpc = RPC(coordination_url=url, timeout=600, loglevel=logging.WARNING)
+    return rpc, (controller, worker), threads
+
+
+def reference_shaped_baseline(names):
+    """One query through the reference's dataflow shape on CPU (see module
+    docstring); returns (wall_seconds, result_df)."""
+    import pandas as pd
+
+    from bqueryd_tpu.storage.ctable import ctable
+
+    t0 = time.perf_counter()
+    shard_tars = []
+    for name in names:
+        # per-query single-threaded decode, no decoded cache (bcolz behavior)
+        t = ctable(os.path.join(DATA_DIR, name), auto_cache=False, nthreads=1)
+        df = pd.DataFrame(
+            {
+                GROUP_COL: t.column_raw(GROUP_COL),
+                MEASURE_COL: t.column_raw(MEASURE_COL),
+            }
+        )
+        part = df.groupby(GROUP_COL, as_index=False)[MEASURE_COL].sum()
+        # worker: result table -> tar bytes (reference bqueryd/worker.py:335-346)
+        buf = io.BytesIO()
+        with tarfile.open(mode="w", fileobj=buf) as tar:
+            blob = pickle.dumps(part, protocol=4)
+            info = tarfile.TarInfo(name="result")
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+        shard_tars.append(buf.getvalue())
+    # controller: tar of tars (reference bqueryd/controller.py:186-211)
+    outer = io.BytesIO()
+    with tarfile.open(mode="w", fileobj=outer) as tar:
+        for i, blob in enumerate(shard_tars):
+            info = tarfile.TarInfo(name=f"shard_{i}")
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    wire = outer.getvalue()
+    # client: untar + untar + concat + re-groupby (reference bqueryd/rpc.py:150-173)
+    parts = []
+    with tarfile.open(mode="r", fileobj=io.BytesIO(wire)) as tar:
+        for member in tar.getmembers():
+            inner = tar.extractfile(member).read()
+            with tarfile.open(mode="r", fileobj=io.BytesIO(inner)) as shard:
+                for m2 in shard.getmembers():
+                    parts.append(pickle.loads(shard.extractfile(m2).read()))
+    merged = (
+        pd.concat(parts, ignore_index=True)
+        .groupby(GROUP_COL, as_index=False)[MEASURE_COL]
+        .sum()
+    )
+    return time.perf_counter() - t0, merged
+
+
+def main():
+    t_start = time.time()
+    names = build_dataset()
+    rpc, nodes, threads = start_cluster()
+    try:
+        import jax
+
+        # warmup: storage decode, XLA compile, HBM/alignment caches
+        result = rpc.groupby(
+            names, [GROUP_COL], [[MEASURE_COL, "sum", MEASURE_COL]], []
+        )
+        ours = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            result = rpc.groupby(
+                names, [GROUP_COL], [[MEASURE_COL, "sum", MEASURE_COL]], []
+            )
+            ours.append(time.perf_counter() - t0)
+        our_wall = min(ours)
+
+        base_walls, base_df = [], None
+        for _ in range(REPEATS):
+            wall, base_df = reference_shaped_baseline(names)
+            base_walls.append(wall)
+        base_wall = min(base_walls)
+
+        # correctness gate: int64 bit-exact against the baseline's answer
+        got = dict(
+            zip(
+                (int(k) for k in result[GROUP_COL]),
+                (int(v) for v in result[MEASURE_COL]),
+            )
+        )
+        for _, row in base_df.iterrows():
+            key, val = int(row[GROUP_COL]), int(row[MEASURE_COL])
+            assert got[key] == val, f"bit-exactness failure at key {key}"
+
+        print(
+            json.dumps(
+                {
+                    "metric": "taxi_groupby_sum_10shard_e2e_rows_per_sec",
+                    "value": round(ROWS / our_wall, 1),
+                    "unit": "rows/s",
+                    "vs_baseline": round(base_wall / our_wall, 3),
+                    "detail": {
+                        "rows": ROWS,
+                        "shards": SHARDS,
+                        "framework_wall_s": round(our_wall, 4),
+                        "reference_shaped_wall_s": round(base_wall, 4),
+                        "backend": jax.default_backend(),
+                        "n_devices": len(jax.devices()),
+                        "total_s": round(time.time() - t_start, 1),
+                    },
+                }
+            )
+        )
+    finally:
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

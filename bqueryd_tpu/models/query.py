@@ -202,21 +202,19 @@ class QueryEngine:
                     table.column_raw(a[0]) for _, a in mergeable
                 )
                 mops = tuple(a[1] for _, a in mergeable)
-                partials = ops.partial_tables(
-                    dense.astype(np.int32), measures, mops, n_groups, mask_arr
+                import jax
+
+                partials = jax.device_get(  # ONE batched D2H round-trip
+                    ops.partial_tables(
+                        dense.astype(np.int32), measures, mops, n_groups,
+                        mask_arr,
+                    )
                 )
-                rows = np.asarray(partials["rows"])
+                rows = partials["rows"]
                 for (i, _a), part in zip(mergeable, partials["aggs"]):
-                    agg_parts[i] = {
-                        k: np.asarray(v) for k, v in part.items()
-                    }
+                    agg_parts[i] = dict(part)
             else:
                 # rows still needed to drop empty groups
-                import jax.numpy as jnp
-
-                valid = dense >= 0
-                if mask_arr is not None:
-                    valid = valid & mask_arr
                 rows = np.asarray(
                     ops.partial_tables(
                         dense.astype(np.int32),
@@ -226,7 +224,6 @@ class QueryEngine:
                         mask_arr,
                     )["rows"]
                 )
-                del jnp
             for i, agg in distinct:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)

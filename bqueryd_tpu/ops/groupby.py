@@ -44,6 +44,63 @@ def _null_mask(values):
     return jnp.zeros(values.shape, dtype=bool)
 
 
+#: rows per scatter block in the exact-int64 segment sum; bounds every block
+#: partial below 2^16 (max limb) * 2^14 = 2^30 < int32 overflow
+_SUM_BLOCK = 16384
+
+#: above this many scatter buckets (blocks x groups) the blocked decomposition
+#: stops paying for itself in HBM; fall back to the direct s64 scatter
+_MAX_BLOCK_SEGMENTS = 1 << 25
+
+
+def _int64_segment_sum(values, valid, safe, n_groups):
+    """Exact per-group int64 sums of integer ``values`` without any int64
+    scatter.
+
+    TPUs emulate s64 (`jax's x64 mode <https://docs.jax.dev>`_) and the
+    emulated scatter-add behind ``segment_sum`` dominates the whole query
+    (~5x the cost of the s32 scatter at 10 M rows, measured on v5e).  Instead:
+    split values into 16-bit limbs (elementwise s64 ops are cheap — only the
+    scatter is not), scatter each limb in int32 over ``blocks x groups``
+    buckets so no bucket can overflow, then reduce the per-block tables in
+    int64 and recombine limbs with shifts.  Bit-exact for the full int64
+    range."""
+    n = values.shape[0]
+    v = jnp.where(valid, values, 0)
+    nbits = values.dtype.itemsize * 8
+    n_blocks = -(-n // _SUM_BLOCK)
+    if n_blocks * n_groups > _MAX_BLOCK_SEGMENTS:
+        return jax.ops.segment_sum(
+            v.astype(jnp.int64), safe, num_segments=n_groups
+        )
+    if nbits <= 16:
+        limbs = [(v.astype(jnp.int32), 0)]
+    else:
+        n_limbs = nbits // 16
+        limbs = [
+            (((v >> (16 * i)) & 0xFFFF).astype(jnp.int32), 16 * i)
+            for i in range(n_limbs - 1)
+        ]
+        # top limb keeps the sign via arithmetic shift
+        limbs.append(
+            ((v >> (16 * (n_limbs - 1))).astype(jnp.int32),
+             16 * (n_limbs - 1))
+        )
+    pad = n_blocks * _SUM_BLOCK - n
+    safe_p = jnp.pad(safe, (0, pad))
+    ids = (
+        jnp.arange(n_blocks * _SUM_BLOCK, dtype=jnp.int32) // _SUM_BLOCK
+    ) * n_groups + safe_p
+    total = jnp.zeros(n_groups, dtype=jnp.int64)
+    for limb, shift in limbs:
+        part = jax.ops.segment_sum(
+            jnp.pad(limb, (0, pad)), ids, num_segments=n_blocks * n_groups
+        )
+        block_sums = part.reshape(n_blocks, n_groups).astype(jnp.int64).sum(0)
+        total = total + (block_sums << shift)
+    return total
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
 def partial_tables(codes, measures, ops, n_groups, mask=None):
     """Compute per-group partial tables for one shard.
@@ -65,7 +122,11 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
     seg_sum = functools.partial(
         jax.ops.segment_sum, segment_ids=safe, num_segments=n_groups
     )
-    rows = seg_sum(valid.astype(jnp.int64))
+
+    def int_count(flags):  # bool[n] -> int64[n_groups], no s64 scatter
+        return _int64_segment_sum(flags.astype(jnp.int8), flags, safe, n_groups)
+
+    rows = int_count(valid)
 
     aggs = []
     for values, op in zip(measures, ops):
@@ -76,17 +137,22 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
         null = _null_mask(values)
         present = valid & ~null
         if op in ("sum", "mean"):
-            acc = _accum_dtype(values.dtype)
-            contrib = jnp.where(present, values, 0).astype(acc)
-            partial = {"sum": seg_sum(contrib)}
+            if jnp.issubdtype(values.dtype, jnp.floating):
+                contrib = jnp.where(present, values, 0).astype(
+                    _accum_dtype(values.dtype)
+                )
+                partial = {"sum": seg_sum(contrib)}
+            else:
+                partial = {
+                    "sum": _int64_segment_sum(values, present, safe, n_groups)
+                }
             if op == "mean":
-                partial["count"] = seg_sum(present.astype(jnp.int64))
+                partial["count"] = int_count(present)
             aggs.append(partial)
         elif op == "count":
-            aggs.append({"count": seg_sum(present.astype(jnp.int64))})
+            aggs.append({"count": int_count(present)})
         elif op == "count_na":
-            na = valid & null
-            aggs.append({"count": seg_sum(na.astype(jnp.int64))})
+            aggs.append({"count": int_count(valid & null)})
         elif op == "min":
             big = (
                 jnp.inf
@@ -97,7 +163,7 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
             aggs.append(
                 {
                     "min": jax.ops.segment_min(fill, safe, num_segments=n_groups),
-                    "count": seg_sum(present.astype(jnp.int64)),
+                    "count": int_count(present),
                 }
             )
         elif op == "max":
@@ -110,7 +176,7 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
             aggs.append(
                 {
                     "max": jax.ops.segment_max(fill, safe, num_segments=n_groups),
-                    "count": seg_sum(present.astype(jnp.int64)),
+                    "count": int_count(present),
                 }
             )
     return {"rows": rows, "aggs": tuple(aggs)}

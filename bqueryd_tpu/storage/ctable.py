@@ -31,12 +31,12 @@ Layout::
 
 import json
 import os
-import threading
 import zlib
 
 import numpy as np
 
 from bqueryd_tpu.storage import codec
+from bqueryd_tpu.utils.cache import BytesCappedCache
 from bqueryd_tpu.utils.fs import mkdir_p, rm_file_or_dir
 
 FORMAT_NAME = "tpucolz"
@@ -104,40 +104,23 @@ class _ColumnMeta:
 # Process-wide decoded-column cache: the in-memory analogue of bquery's
 # auto_cache (reference bqueryd/worker.py:291).  Keyed by (realpath, column,
 # data-file mtime+size) so reshard/activation invalidates naturally.
-_COLUMN_CACHE = {}
-_COLUMN_CACHE_LOCK = threading.Lock()
-_COLUMN_CACHE_MAX_BYTES = int(
-    os.environ.get("BQUERYD_TPU_COLUMN_CACHE_BYTES", 2 * 1024**3)
+_COLUMN_CACHE = BytesCappedCache(
+    int(os.environ.get("BQUERYD_TPU_COLUMN_CACHE_BYTES", 2 * 1024**3))
 )
-_column_cache_bytes = 0
 
 
 def free_cachemem():
     """Drop the process-wide decoded-column cache (parity with bquery's
     ``free_cachemem``, called post-task at reference bqueryd/worker.py:330)."""
-    global _column_cache_bytes
-    with _COLUMN_CACHE_LOCK:
-        _COLUMN_CACHE.clear()
-        _column_cache_bytes = 0
+    _COLUMN_CACHE.clear()
 
 
 def _cache_get(key):
-    with _COLUMN_CACHE_LOCK:
-        return _COLUMN_CACHE.get(key)
+    return _COLUMN_CACHE.get(key)
 
 
 def _cache_put(key, arr):
-    global _column_cache_bytes
-    with _COLUMN_CACHE_LOCK:
-        if key in _COLUMN_CACHE:
-            return
-        nbytes = arr.nbytes
-        if _column_cache_bytes + nbytes > _COLUMN_CACHE_MAX_BYTES:
-            # simple wholesale eviction; queries re-warm what they need
-            _COLUMN_CACHE.clear()
-            _column_cache_bytes = 0
-        _COLUMN_CACHE[key] = arr
-        _column_cache_bytes += nbytes
+    _COLUMN_CACHE.put(key, arr)
 
 
 class ctable:
@@ -225,6 +208,10 @@ class ctable:
         attrs = self.attrs
         attrs.update(kv)
         _atomic_json_dump(attrs, self._attrs_path)
+
+    def physical_dtype(self, name):
+        """Stored physical numpy dtype of a column (metadata only, no decode)."""
+        return np.dtype(self._columns[name].dtype)
 
     def col_stats(self, name):
         """(min, max) over the column's physical values, or None if unknown

@@ -1,0 +1,52 @@
+"""Byte-capped caches shared by the storage and executor layers."""
+
+import threading
+
+
+class BytesCappedCache:
+    """Dict-shaped cache with a byte budget and wholesale eviction.
+
+    Wholesale (clear-everything) eviction is deliberate: entries are
+    query-working-set artifacts that re-warm in one pass, and tracking LRU
+    order costs more than re-warming does.  The in-memory analogue of
+    bquery's auto_cache policy (reference bqueryd/worker.py:291,330).
+    Thread-safe: workers share one instance across request threads.
+    """
+
+    def __init__(self, max_bytes, sizeof=lambda v: v.nbytes):
+        self.max_bytes = int(max_bytes)
+        self._sizeof = sizeof
+        self._data = {}
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key, value, nbytes=None):
+        size = self._sizeof(value) if nbytes is None else nbytes
+        with self._lock:
+            if key in self._data:
+                return
+            if self._bytes + size > self.max_bytes:
+                self._data.clear()
+                self._bytes = 0
+            self._data[key] = value
+            self._bytes += size
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+            self._bytes = 0
+
+    @property
+    def nbytes(self):
+        return self._bytes
+
+    def __len__(self):
+        return len(self._data)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._data

@@ -298,11 +298,37 @@ class WorkerBase:
         except Exception:
             return
         if rss_mb > self.memory_limit_mb:
+            # shed caches first; suicide (the reference's policy, reference
+            # bqueryd/worker.py:232-241) only if that wasn't enough
+            rss_mb = self._shed_caches()
+            if rss_mb is None or rss_mb <= self.memory_limit_mb:
+                return
             self.logger.warning(
                 "RSS %.0f MB above limit %d MB, stopping for supervisor restart",
                 rss_mb, self.memory_limit_mb,
             )
             self.running = False
+
+    def _shed_caches(self):
+        """Drop query caches + collect; returns post-shed RSS in MB."""
+        import gc
+
+        try:
+            from bqueryd_tpu.storage import free_cachemem
+
+            free_cachemem()
+        except Exception:
+            pass
+        executor = getattr(self, "_mesh_executor", None)
+        if executor is not None:
+            executor.clear_caches()
+        gc.collect()
+        try:
+            import psutil
+
+            return psutil.Process(os.getpid()).memory_info().rss / 1e6
+        except Exception:
+            return None
 
 
 class WorkerNode(WorkerBase):
@@ -333,19 +359,22 @@ class WorkerNode(WorkerBase):
         return self._mesh_executor
 
     def _execute(self, tables, query, timer):
-        """One shard -> single-device engine; a batched shard group with
-        psum-mergeable aggregations -> mesh executor (on-device merge); any
-        other multi-shard shape -> per-shard engine + host value-keyed merge.
-        Always returns ONE payload per CalcMessage."""
+        """Psum-mergeable aggregations (any shard count) -> mesh executor
+        (on-device merge + HBM-resident caches); distinct-count / raw-rows
+        single shard -> single-device engine; other multi-shard shapes ->
+        per-shard engine + host value-keyed merge.  Always returns ONE
+        payload per CalcMessage."""
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
+        if MeshQueryExecutor.supports(query):
+            # single shards go through the mesh executor too: its alignment +
+            # HBM block caches make repeat queries one kernel dispatch
+            self.mesh_executor.timer = timer
+            return self.mesh_executor.execute(tables, query)
         if len(tables) == 1:
             self.engine.timer = timer
             return self.engine.execute_local(tables[0], query)
-        if MeshQueryExecutor.supports(query):
-            self.mesh_executor.timer = timer
-            return self.mesh_executor.execute(tables, query)
         self.engine.timer = timer
         payloads = [self.engine.execute_local(t, query) for t in tables]
         with timer.phase("hostmerge"):
@@ -361,7 +390,7 @@ class WorkerNode(WorkerBase):
             return super().handle_work(msg)
 
         from bqueryd_tpu.models.query import GroupByQuery
-        from bqueryd_tpu.storage import ctable, free_cachemem
+        from bqueryd_tpu.storage import ctable
 
         timer = PhaseTimer()
         args, kwargs = msg.get_args_kwargs()
@@ -385,12 +414,12 @@ class WorkerNode(WorkerBase):
         with timer.phase("serialize"):
             data = payload.to_bytes()
         # a result comparable to the worker's memory budget (1/32 of the
-        # restart limit, 64 MB at the default 2 GB) means the column cache is
-        # the next thing to evict
+        # restart limit, 64 MB at the default 2 GB) means the query caches
+        # are the next thing to evict
         if self.memory_limit_mb and sys.getsizeof(data) > (
             self.memory_limit_mb * (1 << 20) // 32
         ):
-            free_cachemem()
+            self._shed_caches()
         reply = msg.copy()
         reply["data"] = data
         reply["phase_timings"] = timer.as_dict()

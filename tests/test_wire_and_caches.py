@@ -1,0 +1,142 @@
+"""Wire narrowing, exact int64 limb sums, and executor cache behavior."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.models.query import GroupByQuery
+from bqueryd_tpu.parallel.executor import (
+    MeshQueryExecutor,
+    _codes_dtype,
+    _freeze,
+    _where_signature,
+    _wire_dtype,
+    make_mesh,
+)
+from bqueryd_tpu.storage.ctable import ctable
+
+
+@pytest.fixture
+def shard_tables(tmp_path):
+    rng = np.random.RandomState(9)
+    frames, tables = [], []
+    for i in range(3):
+        df = pd.DataFrame(
+            {
+                "g": rng.randint(0, 6, 500).astype(np.int64),
+                "v": rng.randint(-30000, 30000, 500).astype(np.int64),
+                "big": rng.randint(-(2**62), 2**62, 500).astype(np.int64),
+                "f": rng.random(500).astype(np.float32),
+            }
+        )
+        root = str(tmp_path / f"s{i}.bcolzs")
+        ctable.fromdataframe(df, root)
+        frames.append(df)
+        tables.append(ctable(root))
+    return frames, tables
+
+
+def test_int64_limb_sum_bit_exact_full_range():
+    """Exact int64 sums via 16-bit limb scatter across the full value range."""
+    import jax
+
+    from bqueryd_tpu import ops
+
+    rng = np.random.RandomState(0)
+    for dtype in (np.int8, np.int16, np.int32, np.int64):
+        info = np.iinfo(dtype)
+        vals = rng.randint(
+            info.min, info.max, 5000, dtype=np.int64
+        ).astype(dtype)
+        codes = rng.randint(0, 7, 5000).astype(np.int32)
+        out = jax.device_get(
+            ops.partial_tables(codes, (vals,), ("sum",), 7)
+        )["aggs"][0]["sum"]
+        expect = np.zeros(7, dtype=np.int64)
+        np.add.at(expect, codes, vals.astype(np.int64))
+        np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_wire_dtype_narrows_by_stats(shard_tables):
+    _, tables = shard_tables
+    assert _wire_dtype(tables, "v") == np.dtype(np.int16)
+    assert _wire_dtype(tables, "big") is None  # full-range int64 can't narrow
+    assert _wire_dtype(tables, "f") is None    # floats ship as stored
+    assert _codes_dtype(6) == np.dtype(np.int8)
+    assert _codes_dtype(1000) == np.dtype(np.int16)
+    assert _codes_dtype(100_000) == np.dtype(np.int32)
+
+
+def test_narrowed_query_matches_pandas(shard_tables):
+    frames, tables = shard_tables
+    q = GroupByQuery(
+        ["g"],
+        [["v", "sum", "vs"], ["v", "min", "vmin"], ["big", "sum", "bs"],
+         ["f", "mean", "fm"]],
+    )
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    r = ex.execute(tables, q)
+    full = pd.concat(frames, ignore_index=True)
+    expect = full.groupby("g").agg(
+        vs=("v", "sum"), vmin=("v", "min"), bs=("big", "sum"),
+        fm=("f", "mean"),
+    )
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_array_equal(
+        r["aggs"][0]["sum"][order], expect["vs"].to_numpy()
+    )
+    got_min = r["aggs"][1]["min"][order]
+    assert got_min.dtype == np.int64  # restored to the stored dtype
+    np.testing.assert_array_equal(got_min, expect["vmin"].to_numpy())
+    # int64 sums wrap mod 2^64 exactly like numpy; compare against numpy
+    np.testing.assert_array_equal(
+        r["aggs"][2]["sum"][order], expect["bs"].to_numpy()
+    )
+    np.testing.assert_allclose(
+        r["aggs"][3]["sum"][order] / r["aggs"][3]["count"][order],
+        expect["fm"].to_numpy(),
+        rtol=1e-6,
+    )
+
+
+def test_set_and_array_where_terms_cacheable(shard_tables):
+    frames, tables = shard_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    q = GroupByQuery(
+        ["g"], [["v", "sum", "vs"]], where_terms=[["g", "in", {1, 2}]]
+    )
+    r = ex.execute(tables, q)  # must not crash on the set-valued term
+    full = pd.concat(frames, ignore_index=True)
+    expect = full[full["g"].isin([1, 2])].groupby("g")["v"].sum()
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_array_equal(
+        r["aggs"][0]["sum"][order], expect.to_numpy()
+    )
+    # distinct arrays with identical truncated reprs must not collide
+    a = np.arange(2000)
+    b = a.copy()
+    b[1000] = -1
+    sig_a = _freeze(a)
+    sig_b = _freeze(b)
+    assert sig_a != sig_b
+    assert _freeze({1, 2}) == _freeze({2, 1})
+
+
+def test_repeat_query_hits_caches(shard_tables):
+    frames, tables = shard_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    q = GroupByQuery(["g"], [["v", "sum", "vs"]])
+    ex.execute(tables, q)
+    assert len(ex._hbm_cache) == 2  # codes + one measure block
+    assert len(ex._align_cache) == 1
+    before = len(ex._hbm_cache)
+    ex.execute(tables, q)
+    assert len(ex._hbm_cache) == before  # no new blocks on repeat
+    ex.clear_caches()
+    assert len(ex._hbm_cache) == 0 and ex._hbm_cache.nbytes == 0
+
+
+def test_where_signature_distinguishes_filters():
+    q1 = GroupByQuery(["g"], [["v", "sum", "v"]], where_terms=[["v", ">", 1]])
+    q2 = GroupByQuery(["g"], [["v", "sum", "v"]], where_terms=[["v", ">", 2]])
+    assert _where_signature(q1) != _where_signature(q2)
