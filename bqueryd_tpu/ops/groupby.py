@@ -5,9 +5,19 @@ real computation happens in the reference, reference bqueryd/worker.py:311-314).
 Design:
 
 * group keys arrive as dense int codes (see :mod:`bqueryd_tpu.ops.factorize`);
-  the kernel is pure segment arithmetic — ``segment_sum`` / ``segment_min`` /
-  ``segment_max`` over static ``num_segments`` — so XLA sees static shapes and
-  fuses the mask/NaN handling into the scatter pass;
+* the hot reduction (sums and counts) runs on the **MXU as a one-hot
+  matmul**, not a scatter: XLA lowers ``segment_sum`` to scatter-add, which
+  on TPU costs ~90 ms for 10 M rows (and ~9x that again in emulated-s64
+  mode), while the same contraction as ``limbs[blocks, R, K] x
+  one_hot(codes)[blocks, K, G]`` rides the systolic array in ~1-4 ms.
+  Exactness is preserved by 8-bit limb decomposition: every value is biased
+  to unsigned, split into byte limbs (each exactly representable in
+  bfloat16), and block sums are bounded below 2^24 so the MXU's float32
+  accumulation is exact; per-block tables are then recombined in uint64
+  (mod-2^64 arithmetic == two's complement) — bit-exact for the full int64
+  range.  Counts ride along as a row of ones in the same matmul.  min/max,
+  float64 measures, and cardinalities above ``matmul_groups_limit()`` fall
+  back to the scatter path;
 * results are produced as **partial tables** (pytrees of fixed-width arrays,
   e.g. mean = {sum, count}) that are closed under elementwise merge: merging
   shard partials is ``combine_partials`` on host/device or ``psum_partials``
@@ -22,9 +32,11 @@ count_distinct, sorted_count_distinct) plus min/max.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 # canonical definitions live JAX-free in models.query (the controller needs
 # them to decide shard batching without importing jax); re-exported here
@@ -101,7 +113,45 @@ def _int64_segment_sum(values, valid, safe, n_groups):
     return total
 
 
-@functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
+#: rows per MXU block: 8-bit limb block sums stay <= 32768 * 255 < 2^24, so
+#: the MXU's float32 accumulation of a block is exact
+_MATMUL_BLOCK = 32768
+
+
+def matmul_groups_limit():
+    """Above this group cardinality the one-hot matmul's N*G FLOPs cost more
+    than the scatter it replaces (crossover ~8-16k groups at 10 M rows on
+    v5e); tune with BQUERYD_TPU_MATMUL_GROUPS (0 disables the MXU path)."""
+    return int(os.environ.get("BQUERYD_TPU_MATMUL_GROUPS", 8192))
+
+
+def _matmul_cells_limit():
+    """Cap on rows * groups for the MXU path: bounds the one-hot contraction's
+    FLOPs (and its worst-case materialized size, should an XLA version decline
+    to fuse the one-hot into the dot).  Default ~6.9e10 cells = the measured
+    10 M-row x 8k-group crossover on v5e."""
+    return int(os.environ.get("BQUERYD_TPU_MATMUL_CELLS", 1 << 36))
+
+
+def _matmul_profitable(measures, ops, n, n_groups):
+    """MXU path only when within budget AND some sum/count actually rides the
+    matmul (min/max and float64 sums scatter regardless, so a query made only
+    of those gains nothing from building the one-hot)."""
+    if not (0 < n_groups <= matmul_groups_limit()):
+        return False
+    if n * n_groups > _matmul_cells_limit():
+        return False
+    x64 = bool(jax.config.jax_enable_x64)
+    for values, op in zip(measures, ops):
+        if op in ("count", "count_na"):
+            return True
+        if op in ("sum", "mean") and not (
+            x64 and jnp.dtype(values.dtype) == jnp.float64
+        ):
+            return True
+    return not measures  # rows-count-only query still benefits
+
+
 def partial_tables(codes, measures, ops, n_groups, mask=None):
     """Compute per-group partial tables for one shard.
 
@@ -113,7 +163,207 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
 
     Returns a pytree: {"rows": int64[n_groups],
                        "aggs": tuple of per-measure partial dicts}.
+
+    Sums and counts route to the MXU one-hot matmul (module docstring) when
+    the cardinality is within :func:`matmul_groups_limit`; min/max, float64
+    measures, and high-cardinality queries use segment scatters.
     """
+    ops = tuple(ops)
+    measures = tuple(measures)
+    if _matmul_profitable(measures, ops, int(codes.shape[0]), int(n_groups)):
+        return _partial_tables_mm(codes, measures, ops, int(n_groups), mask)
+    return _partial_tables_scatter(codes, measures, ops, int(n_groups), mask)
+
+
+def _segment_extremum(kind, values, present, safe, n_groups):
+    """Per-group min/max via segment scatter; absent rows carry the identity
+    fill so they never win (empty groups are masked later by count==0)."""
+    floating = jnp.issubdtype(values.dtype, jnp.floating)
+    if kind == "min":
+        fill = jnp.inf if floating else jnp.iinfo(values.dtype).max
+        return jax.ops.segment_min(
+            jnp.where(present, values, fill), safe, num_segments=n_groups
+        )
+    fill = -jnp.inf if floating else jnp.iinfo(values.dtype).min
+    return jax.ops.segment_max(
+        jnp.where(present, values, fill), safe, num_segments=n_groups
+    )
+
+
+def _blocked(arr, nb, pad, fill=0):
+    """Pad a row vector to ``nb * _MATMUL_BLOCK`` and shape it ``[nb, K]``."""
+    return jnp.pad(arr, (0, pad), constant_values=fill).reshape(
+        nb, _MATMUL_BLOCK
+    )
+
+
+def _limb_rows(values, nbits):
+    """8-bit unsigned limbs of biased values, each as an exact bfloat16 row.
+
+    Signed inputs are biased by ``2^(nbits-1)`` into unsigned range; the
+    wrap-around of the uint64 cast is harmless because only the low
+    ``nbits/8`` limbs are extracted (arithmetic mod 2^nbits), and the bias is
+    subtracted again group-wise (``count * bias``) after the merge."""
+    signed = jnp.issubdtype(values.dtype, jnp.signedinteger)
+    u = values.astype(jnp.uint64)
+    bias = 0
+    if signed:
+        bias = int(1) << (nbits - 1)
+        u = u + jnp.uint64(bias)
+    rows = [
+        (
+            (lax.shift_right_logical(u, jnp.uint64(8 * i)) & jnp.uint64(0xFF))
+            .astype(jnp.bfloat16)
+        )
+        for i in range(nbits // 8)
+    ]
+    return rows, bias
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
+def _partial_tables_mm(codes, measures, ops, n_groups, mask=None):
+    """MXU path: one ``dot_general`` of stacked bf16 rows (a ones row for
+    counts, byte limbs for int sums, a hi/lo bf16 pair for float32 sums)
+    against the blocked one-hot of the folded codes."""
+    valid = codes >= 0
+    if mask is not None:
+        valid = valid & mask
+    n = codes.shape[0]
+    nb = -(-n // _MATMUL_BLOCK)
+    pad = nb * _MATMUL_BLOCK - n
+
+    folded = jnp.where(valid, codes, -1).astype(jnp.int32)
+    c_blk = _blocked(folded, nb, pad, fill=-1)
+    one_hot = (
+        c_blk[:, :, None] == jnp.arange(n_groups, dtype=jnp.int32)[None, None, :]
+    ).astype(jnp.bfloat16)
+
+    rows = []          # flat [n] bf16 rows, blocked right before the dot
+    int_rows = []      # indices reduced exactly in uint64
+    float_rows = []    # indices reduced in float64
+
+    def add_int(row):
+        rows.append(row)
+        int_rows.append(len(rows) - 1)
+        return len(rows) - 1
+
+    def add_float(row):
+        rows.append(row)
+        float_rows.append(len(rows) - 1)
+        return len(rows) - 1
+
+    valid_count_row = add_int(valid.astype(jnp.bfloat16))
+
+    # per-measure row plans, resolved after the single dot below
+    plans = []
+    for values, op in zip(measures, ops):
+        if op not in MERGEABLE_OPS:
+            raise ValueError(
+                f"op {op!r} has no mergeable partial; use the dedicated kernel"
+            )
+        is_float = jnp.issubdtype(values.dtype, jnp.floating)
+        if is_float:
+            null = _null_mask(values)
+            present_row = add_int((valid & ~null).astype(jnp.bfloat16))
+        else:
+            present_row = valid_count_row
+        if op in ("sum", "mean"):
+            if not is_float:
+                v = values
+                if v.dtype == jnp.bool_:
+                    v = v.astype(jnp.uint8)
+                nbits = v.dtype.itemsize * 8
+                limbs, bias = _limb_rows(v, nbits)
+                idxs = [add_int(r) for r in limbs]
+                plans.append(("int_sum", op, idxs, bias, present_row))
+            elif values.dtype == jnp.float64 and jax.config.jax_enable_x64:
+                plans.append(("f64_scatter", op, values, present_row))
+            else:
+                v = values.astype(jnp.float32)
+                v = jnp.where(valid & ~_null_mask(v), v, 0.0)
+                hi = v.astype(jnp.bfloat16)
+                lo = (v - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                plans.append(
+                    ("float_sum", op, add_float(hi), add_float(lo), present_row)
+                )
+        elif op == "count":
+            plans.append(("count", op, present_row))
+        elif op == "count_na":
+            null_row = add_int((valid & _null_mask(values)).astype(jnp.bfloat16))
+            plans.append(("count", op, null_row))
+        elif op in ("min", "max"):
+            plans.append((op, op, values, present_row))
+
+    lhs = jnp.stack([_blocked(r, nb, pad) for r in rows], axis=1)  # [nb,R,K]
+    out = lax.dot_general(
+        lhs,
+        one_hot,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [nb, R, G]
+
+    int_idx = jnp.asarray(int_rows, dtype=jnp.int32)
+    tot_u = jnp.take(out, int_idx, axis=1).astype(jnp.uint64).sum(axis=0)
+    u_pos = {ridx: i for i, ridx in enumerate(int_rows)}
+    if float_rows:
+        f_idx = jnp.asarray(float_rows, dtype=jnp.int32)
+        f_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        tot_f = jnp.take(out, f_idx, axis=1).astype(f_dt).sum(axis=0)
+        f_pos = {ridx: i for i, ridx in enumerate(float_rows)}
+
+    def int_row(ridx):
+        return tot_u[u_pos[ridx]]
+
+    rows_count = int_row(valid_count_row).astype(jnp.int64)
+    safe = jnp.where(valid, codes, 0).astype(jnp.int32)
+
+    aggs = []
+    for plan in plans:
+        kind, op = plan[0], plan[1]
+        if kind == "int_sum":
+            _, _, idxs, bias, present_row = plan
+            s = jnp.zeros(n_groups, dtype=jnp.uint64)
+            for j, ridx in enumerate(idxs):
+                s = s + (int_row(ridx) << jnp.uint64(8 * j))
+            count = int_row(present_row)
+            if bias:
+                s = s - count * jnp.uint64(bias)
+            partial = {"sum": s.astype(jnp.int64)}
+            if op == "mean":
+                partial["count"] = count.astype(jnp.int64)
+            aggs.append(partial)
+        elif kind == "float_sum":
+            _, _, hi_idx, lo_idx, present_row = plan
+            partial = {"sum": tot_f[f_pos[hi_idx]] + tot_f[f_pos[lo_idx]]}
+            if op == "mean":
+                partial["count"] = int_row(present_row).astype(jnp.int64)
+            aggs.append(partial)
+        elif kind == "f64_scatter":
+            _, _, values, present_row = plan
+            present = valid & ~_null_mask(values)
+            contrib = jnp.where(present, values, 0).astype(jnp.float64)
+            partial = {
+                "sum": jax.ops.segment_sum(contrib, safe, num_segments=n_groups)
+            }
+            if op == "mean":
+                partial["count"] = int_row(present_row).astype(jnp.int64)
+            aggs.append(partial)
+        elif kind == "count":
+            _, _, ridx = plan
+            aggs.append({"count": int_row(ridx).astype(jnp.int64)})
+        elif kind in ("min", "max"):
+            _, _, values, present_row = plan
+            present = valid & ~_null_mask(values)
+            ext = _segment_extremum(kind, values, present, safe, n_groups)
+            aggs.append(
+                {kind: ext, "count": int_row(present_row).astype(jnp.int64)}
+            )
+    return {"rows": rows_count, "aggs": tuple(aggs)}
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
+def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
+    """Scatter path: blocked-int32 segment sums (exact, no s64 scatter)."""
     valid = codes >= 0
     if mask is not None:
         valid = valid & mask
@@ -153,29 +403,10 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
             aggs.append({"count": int_count(present)})
         elif op == "count_na":
             aggs.append({"count": int_count(valid & null)})
-        elif op == "min":
-            big = (
-                jnp.inf
-                if jnp.issubdtype(values.dtype, jnp.floating)
-                else jnp.iinfo(values.dtype).max
-            )
-            fill = jnp.where(present, values, big)
+        elif op in ("min", "max"):
             aggs.append(
                 {
-                    "min": jax.ops.segment_min(fill, safe, num_segments=n_groups),
-                    "count": int_count(present),
-                }
-            )
-        elif op == "max":
-            small = (
-                -jnp.inf
-                if jnp.issubdtype(values.dtype, jnp.floating)
-                else jnp.iinfo(values.dtype).min
-            )
-            fill = jnp.where(present, values, small)
-            aggs.append(
-                {
-                    "max": jax.ops.segment_max(fill, safe, num_segments=n_groups),
+                    op: _segment_extremum(op, values, present, safe, n_groups),
                     "count": int_count(present),
                 }
             )

@@ -333,7 +333,9 @@ class MeshQueryExecutor:
                 self._align_cache.put(
                     (tables_key, cols_key),
                     (dense, combos, cards, key_values),
-                    nbytes=sum(d.nbytes for d in dense) + combos.nbytes,
+                    nbytes=sum(d.nbytes for d in dense)
+                    + combos.nbytes
+                    + sum(v.nbytes for v in key_values.values()),
                 )
             else:
                 dense, combos, cards, key_values = cached

@@ -300,12 +300,16 @@ class WorkerBase:
         if rss_mb > self.memory_limit_mb:
             # shed caches first; suicide (the reference's policy, reference
             # bqueryd/worker.py:232-241) only if that wasn't enough
-            rss_mb = self._shed_caches()
-            if rss_mb is None or rss_mb <= self.memory_limit_mb:
+            shed_mb = self._shed_caches()
+            if shed_mb is not None and shed_mb <= self.memory_limit_mb:
                 return
+            # unmeasurable post-shed RSS counts as still-over: the pre-shed
+            # reading already proved the limit breached, and a silent pass
+            # here would disable the supervisor-restart safety net
             self.logger.warning(
-                "RSS %.0f MB above limit %d MB, stopping for supervisor restart",
-                rss_mb, self.memory_limit_mb,
+                "RSS %s MB above limit %d MB, stopping for supervisor restart",
+                "?" if shed_mb is None else f"{shed_mb:.0f}",
+                self.memory_limit_mb,
             )
             self.running = False
 

@@ -36,12 +36,17 @@ def shard_tables(tmp_path):
     return frames, tables
 
 
-def test_int64_limb_sum_bit_exact_full_range():
-    """Exact int64 sums via 16-bit limb scatter across the full value range."""
+@pytest.mark.parametrize("path", ["mxu_matmul", "scatter"])
+def test_int64_sum_bit_exact_full_range(path, monkeypatch):
+    """Exact int64 sums across the full value range on BOTH kernel paths:
+    the 8-bit-limb MXU matmul (default) and the 16-bit-limb blocked scatter
+    (high-cardinality fallback, forced via BQUERYD_TPU_MATMUL_GROUPS=0)."""
     import jax
 
     from bqueryd_tpu import ops
 
+    if path == "scatter":
+        monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", "0")
     rng = np.random.RandomState(0)
     for dtype in (np.int8, np.int16, np.int32, np.int64):
         info = np.iinfo(dtype)
@@ -55,6 +60,41 @@ def test_int64_limb_sum_bit_exact_full_range():
         expect = np.zeros(7, dtype=np.int64)
         np.add.at(expect, codes, vals.astype(np.int64))
         np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+@pytest.mark.parametrize("op", ["sum", "mean", "count", "count_na", "min", "max"])
+def test_mm_and_scatter_paths_agree(op, monkeypatch):
+    """The MXU and scatter kernels must be interchangeable: identical results
+    for every mergeable op, with nulls, masks and negative (dropped) codes."""
+    import jax
+
+    from bqueryd_tpu import ops
+
+    rng = np.random.RandomState(5)
+    n, g = 20_000, 23
+    codes = rng.randint(-1, g, n).astype(np.int32)
+    mask = rng.random(n) < 0.8
+    vals = (rng.random(n) * 1000 - 500).astype(np.float32)
+    vals[rng.random(n) < 0.05] = np.nan
+
+    def run():
+        return jax.device_get(
+            ops.partial_tables(codes, (vals,), (op,), g, mask=mask)
+        )
+
+    mm = run()
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", "0")
+    scatter = run()
+    np.testing.assert_array_equal(mm["rows"], scatter["rows"])
+    # float32 sums cancel heavily here (values in ±500, group sums ~1e2), so
+    # compare with an absolute floor scaled to the summed magnitude instead of
+    # pure rtol: both kernels carry ~1e-7 relative accumulation noise.
+    atol = 1e-6 * float(np.nansum(np.abs(vals)))
+    for key in scatter["aggs"][0]:
+        np.testing.assert_allclose(
+            mm["aggs"][0][key], scatter["aggs"][0][key], rtol=1e-4, atol=atol,
+            err_msg=f"op={op} partial={key}",
+        )
 
 
 def test_wire_dtype_narrows_by_stats(shard_tables):
