@@ -171,7 +171,14 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
     ops = tuple(ops)
     measures = tuple(measures)
     if _matmul_profitable(measures, ops, int(codes.shape[0]), int(n_groups)):
-        return _partial_tables_mm(codes, measures, ops, int(n_groups), mask)
+        # env flags are read HERE, outside jit, so toggling them takes effect
+        # per call instead of being frozen into the first trace
+        from bqueryd_tpu.ops import pallas_groupby
+
+        return _partial_tables_mm(
+            codes, measures, ops, int(n_groups), mask,
+            use_pallas=pallas_groupby.pallas_enabled(),
+        )
     return _partial_tables_scatter(codes, measures, ops, int(n_groups), mask)
 
 
@@ -220,8 +227,11 @@ def _limb_rows(values, nbits):
     return rows, bias
 
 
-@functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
-def _partial_tables_mm(codes, measures, ops, n_groups, mask=None):
+@functools.partial(
+    jax.jit, static_argnames=("n_groups", "ops", "use_pallas")
+)
+def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
+                       use_pallas=False):
     """MXU path: one ``dot_general`` of stacked bf16 rows (a ones row for
     counts, byte limbs for int sums, a hi/lo bf16 pair for float32 sums)
     against the blocked one-hot of the folded codes."""
@@ -234,9 +244,6 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None):
 
     folded = jnp.where(valid, codes, -1).astype(jnp.int32)
     c_blk = _blocked(folded, nb, pad, fill=-1)
-    one_hot = (
-        c_blk[:, :, None] == jnp.arange(n_groups, dtype=jnp.int32)[None, None, :]
-    ).astype(jnp.bfloat16)
 
     rows = []          # flat [n] bf16 rows, blocked right before the dot
     int_rows = []      # indices reduced exactly in uint64
@@ -294,13 +301,31 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None):
         elif op in ("min", "max"):
             plans.append((op, op, values, present_row))
 
-    lhs = jnp.stack([_blocked(r, nb, pad) for r in rows], axis=1)  # [nb,R,K]
-    out = lax.dot_general(
-        lhs,
-        one_hot,
-        (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )  # [nb, R, G]
+    if use_pallas:
+        from bqueryd_tpu.ops import pallas_groupby
+
+        # fused VMEM kernel: one-hot tiles formed on the fly, never in HBM
+        out = pallas_groupby.onehot_rows_dot(
+            folded,
+            jnp.stack(rows, axis=0),
+            n_rows=len(rows),
+            n_groups=n_groups,
+            interpret=jax.default_backend() != "tpu",
+        )[:, : len(rows), :n_groups]
+    else:
+        lhs = jnp.stack(
+            [_blocked(r, nb, pad) for r in rows], axis=1
+        )  # [nb,R,K]
+        one_hot = (
+            c_blk[:, :, None]
+            == jnp.arange(n_groups, dtype=jnp.int32)[None, None, :]
+        ).astype(jnp.bfloat16)
+        out = lax.dot_general(
+            lhs,
+            one_hot,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [nb, R, G]
 
     int_idx = jnp.asarray(int_rows, dtype=jnp.int32)
     tot_u = jnp.take(out, int_idx, axis=1).astype(jnp.uint64).sum(axis=0)

@@ -1,0 +1,138 @@
+"""Pallas TPU kernel for the groupby one-hot contraction.
+
+The MXU groupby path (:mod:`bqueryd_tpu.ops.groupby`) reduces stacked bf16
+rows (count flags, value limbs, float hi/lo pairs) against the one-hot of the
+group codes.  XLA already fuses the one-hot formation into the dot operand;
+this Pallas kernel makes that explicit and keeps the whole contraction in
+VMEM: per grid step it DMAs one ``[R, K]`` row block plus one ``[K]`` code
+block, forms ``[KT, G]`` one-hot tiles on the fly (broadcasted-iota compare —
+never materialized to HBM), feeds the MXU, and accumulates the block's
+``[R, G]`` partial in a float32 VMEM scratch.  Per-block partials stay below
+2^24 (the caller bounds K * max-row-value), so the float32 accumulation is
+exact and the caller's uint64 block reduction preserves bit-exact int64
+sums — identical numerics to the XLA path by construction.
+
+The kernel is traced with x64 disabled (Mosaic rejects the i64 loop/index
+constants that x64 mode inserts) — safe because every operand is explicitly
+i32/bf16/f32.
+
+Usage is opt-in via ``BQUERYD_TPU_PALLAS=1`` (auto-interpret on CPU, where the
+same kernel runs under the Pallas interpreter for test coverage).  On the
+tunneled single-chip dev backend the XLA path measures within ~2x of the HBM
+bandwidth floor already, so the default stays XLA; the Pallas path exists for
+real multi-chip deployments where the fused formation saves the one-hot
+regeneration VPU pass per dot and for cardinalities where the ``[nb, K, G]``
+operand would otherwise spill.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: rows per grid block; must match ops.groupby._MATMUL_BLOCK so the caller's
+#: exactness bound (block sums < 2^24) applies unchanged
+BLOCK_K = 32768
+
+#: sublane multiple for the stacked-rows operand
+_SUBLANE = 8
+
+
+def pallas_enabled():
+    """Opt-in flag: BQUERYD_TPU_PALLAS=1 routes the groupby contraction
+    through the Pallas kernel (interpreted on CPU backends)."""
+    return os.environ.get("BQUERYD_TPU_PALLAS", "0") == "1"
+
+
+def _round_up(x, mult):
+    return -(-x // mult) * mult
+
+
+def _make_kernel(n_rows, n_groups, tile_k):
+    def kernel(codes_ref, lhs_ref, out_ref, acc_ref):
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body(kt, carry):
+            off = kt * jnp.int32(tile_k)
+            c = codes_ref[pl.ds(off, tile_k)]  # [KT] i32
+            iota = lax.broadcasted_iota(jnp.int32, (tile_k, n_groups), 1)
+            one_hot = (c[:, None] == iota).astype(jnp.bfloat16)  # [KT, G]
+            lhs = lhs_ref[:, pl.ds(off, tile_k)]  # [R, KT] bf16
+            acc_ref[:] += lax.dot_general(
+                lhs,
+                one_hot,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return carry
+
+        lax.fori_loop(
+            jnp.int32(0), jnp.int32(BLOCK_K // tile_k), body, jnp.int32(0)
+        )
+        out_ref[0] = acc_ref[:]
+
+    return kernel
+
+
+def _tile_k(n_groups):
+    """Largest inner K tile whose bf16 one-hot stays within ~4 MB of VMEM.
+
+    Restricted to powers of two so the tile always divides ``BLOCK_K`` —
+    a non-divisor would truncate the block loop and silently drop rows."""
+    budget = (1 << 21) // max(n_groups, 128)
+    tile = 256
+    while tile * 2 <= min(budget, 2048):
+        tile *= 2
+    return tile
+
+
+def _call(codes_flat, lhs, n_rows, n_groups, interpret):
+    nb = codes_flat.shape[0] // BLOCK_K
+    tile = _tile_k(n_groups)
+    return pl.pallas_call(
+        _make_kernel(n_rows, n_groups, tile),
+        out_shape=jax.ShapeDtypeStruct((nb, n_rows, n_groups), jnp.float32),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_K,), lambda b: (b,), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (n_rows, BLOCK_K), lambda b: (0, b), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_rows, n_groups),
+            lambda b: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[pltpu.VMEM((n_rows, n_groups), jnp.float32)],
+        interpret=interpret,
+    )(codes_flat, lhs)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "n_groups", "interpret")
+)
+def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
+    """``out[b, r, g] = sum_k rows[r, b*K+k] * (codes[b*K+k] == g)``.
+
+    codes: int32[n] folded group codes (negative = contributes nowhere)
+    rows:  bf16[R, n] stacked reduction rows (R == n_rows)
+    Returns float32[nb, R8, G128] where R8/G128 are R and n_groups rounded up
+    to hardware tile multiples — callers slice ``[:, :R, :G]``.
+    """
+    n = codes.shape[0]
+    npad = _round_up(max(n, 1), BLOCK_K)
+    rpad = _round_up(n_rows, _SUBLANE)
+    gpad = _round_up(n_groups, 128)
+    codes_p = jnp.pad(
+        codes.astype(jnp.int32), (0, npad - n), constant_values=-1
+    )
+    rows_p = jnp.pad(
+        rows.astype(jnp.bfloat16), ((0, rpad - n_rows), (0, npad - n))
+    )
+    with jax.enable_x64(False):
+        return _call(codes_p, rows_p, rpad, gpad, interpret)

@@ -97,6 +97,53 @@ def test_mm_and_scatter_paths_agree(op, monkeypatch):
         )
 
 
+@pytest.mark.parametrize("op,g", [
+    ("sum", 150),        # small-G: 2048 tile
+    ("sum", 1300),       # pads to 1408 lanes: non-pow2 G, 1024 tile, two
+                         # blocks — the shapes that once truncated the block
+                         # loop when tiles weren't forced to divide BLOCK_K
+    ("mean", 150),
+    ("count_na", 150),
+])
+def test_pallas_kernel_matches_xla_path(op, g, monkeypatch):
+    """BQUERYD_TPU_PALLAS=1 routes the one-hot contraction through the Pallas
+    kernel (interpreted off-TPU); results must be bit-identical to the XLA
+    path, which shares the limb plan and differs only in who forms the
+    one-hot.  The flag is a static jit arg read per call in the un-jitted
+    dispatcher, so the two runs trace distinct executables."""
+    import jax
+
+    from bqueryd_tpu import ops
+    from bqueryd_tpu.ops import pallas_groupby
+
+    if g == 1300:  # regression guard: this landing must use a dividing tile
+        assert pallas_groupby.BLOCK_K % pallas_groupby._tile_k(1408) == 0
+
+    rng = np.random.RandomState(9)
+    n = 40_000  # pads to two 32768 blocks
+    codes = rng.randint(-1, g, n).astype(np.int32)
+    mask = rng.random(n) < 0.9
+    ivals = rng.randint(-(2**40), 2**40, n).astype(np.int64)
+    fvals = (rng.random(n) * 100).astype(np.float32)
+    fvals[rng.random(n) < 0.03] = np.nan
+    vals = fvals if op == "count_na" else ivals
+
+    def run():
+        return jax.device_get(
+            ops.partial_tables(codes, (vals,), (op,), g, mask=mask)
+        )
+
+    xla = run()
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    pallas = run()
+    np.testing.assert_array_equal(xla["rows"], pallas["rows"])
+    for key in xla["aggs"][0]:
+        np.testing.assert_array_equal(
+            xla["aggs"][0][key], pallas["aggs"][0][key],
+            err_msg=f"op={op} partial={key}",
+        )
+
+
 def test_wire_dtype_narrows_by_stats(shard_tables):
     _, tables = shard_tables
     assert _wire_dtype(tables, "v") == np.dtype(np.int16)
