@@ -388,6 +388,13 @@ class ControllerNode:
                 return
             if msg.get("payload") == "peer_info":
                 self.handle_peer(msg)
+            elif msg.get("_relayed") and msg.get("payload") in (
+                "killall", "kill", "loglevel",
+            ):
+                # control verb fanned out by a peer controller (reference
+                # bqueryd/controller.py:291-295): dispatch like an RPC, but
+                # there is no client to answer (no token)
+                getattr(self, f"rpc_{msg['payload']}")(msg)
             else:
                 self.handle_worker(frames[0], msg)
             return
@@ -549,12 +556,12 @@ class ControllerNode:
     def rpc_ping(self, msg):
         reply = msg.copy()
         reply["payload"] = "pong"
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
 
     def rpc_info(self, msg):
         reply = msg.copy()
         reply.add_as_binary("result", self.get_info())
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
 
     def get_info(self, include_peers=True):
         info = {
@@ -585,7 +592,7 @@ class ControllerNode:
         bqueryd_tpu.logger.setLevel(level)
         reply = msg.copy()
         reply["payload"] = "OK"
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
 
     def _fan_out_to_workers(self, msg):
         for worker_id in list(self.worker_map):
@@ -614,7 +621,7 @@ class ControllerNode:
     def rpc_kill(self, msg):
         reply = msg.copy()
         reply["payload"] = "OK"
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
         self.running = False
 
     def rpc_killworkers(self, msg):
@@ -622,10 +629,12 @@ class ControllerNode:
         self._fan_out_to_workers(kill)
         reply = msg.copy()
         reply["payload"] = "OK"
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
 
     def rpc_killall(self, msg):
-        self.rpc_killworkers(msg.copy())
+        fan = msg.copy()
+        fan.pop("token", None)  # killall itself answers the client, not this
+        self.rpc_killworkers(fan)
         if not msg.get("_relayed"):
             for addr in list(self.others):
                 fan = RPCMessage({"payload": "killall", "_relayed": True})
@@ -637,7 +646,7 @@ class ControllerNode:
                     pass
         reply = msg.copy()
         reply["payload"] = "OK"
-        self.reply_rpc_message(msg["token"], reply)
+        self.reply_rpc_message(msg.get("token"), reply)
         self.running = False
 
     def rpc_sleep(self, msg):
@@ -650,7 +659,7 @@ class ControllerNode:
                 self.worker_out_messages[None].append(scatter)
             reply = msg.copy()
             reply["payload"] = "OK"
-            self.reply_rpc_message(msg["token"], reply)
+            self.reply_rpc_message(msg.get("token"), reply)
             return
         calc = CalcMessage({"payload": "sleep", "token": msg["token"]})
         calc.set_args_kwargs(args, kwargs)
@@ -674,7 +683,7 @@ class ControllerNode:
             self.worker_out_messages[None].append(calc)
             reply = msg.copy()
             reply["payload"] = "OK"
-            self.reply_rpc_message(msg["token"], reply)
+            self.reply_rpc_message(msg.get("token"), reply)
         else:
             self.worker_out_messages[None].append(calc)
 

@@ -443,9 +443,16 @@ class WorkerNode(WorkerBase):
         function = msg.get("function") or kwargs.pop("function", None)
         if not function:
             raise ValueError("execute_code needs a function=module.path.fn")
+        # reference calling convention (reference bqueryd/worker.py:250-267):
+        # the function's positional/keyword args travel as the RPC kwargs
+        # `args=[...]` / `kwargs={...}`
+        call_args = kwargs.pop("args", None) or list(args)
+        call_kwargs = kwargs.pop("kwargs", None) or {}
+        # any other keywords are the function's own (direct-kwarg convention)
+        call_kwargs = {**kwargs, **call_kwargs}
         module_name, _, fn_name = function.rpartition(".")
         fn = getattr(importlib.import_module(module_name), fn_name)
-        result = fn(*args, **kwargs)
+        result = fn(*call_args, **call_kwargs)
         reply = msg.copy()
         reply.add_as_binary("result", result)
         return reply

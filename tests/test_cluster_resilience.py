@@ -1,0 +1,269 @@
+"""Failure-path and control-plane coverage the reference never had.
+
+SURVEY.md §4 lists the reference's test blind spots: multi-controller
+peering, worker death/cull, execute_code, and the memory watchdog.  These
+tests close them, using the same threads-as-nodes topology as
+tests/test_rpc_cluster.py (the reference's own fixture style, reference
+tests/test_simple_rpc.py:42-74) with condition polling instead of sleeps.
+"""
+
+import logging
+import os
+import threading
+
+import pytest
+
+from conftest import wait_until
+
+
+def _start(*nodes):
+    threads = [
+        threading.Thread(target=node.go, daemon=True) for node in nodes
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _stop(nodes, threads):
+    for node in nodes:
+        node.running = False
+    for t in threads:
+        t.join(timeout=5)
+
+
+@pytest.fixture
+def small_cluster(tmp_path, mem_store_url):
+    """One controller + one calc worker, fast heartbeats, no data files."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import WorkerNode
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.1,
+        dead_worker_timeout=10.0,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    threads = _start(controller, worker)
+    wait_until(lambda: controller.worker_map, desc="worker registration")
+    rpc = RPC(
+        coordination_url=mem_store_url, timeout=30, loglevel=logging.WARNING
+    )
+    yield {"rpc": rpc, "controller": controller, "worker": worker}
+    _stop([controller, worker], threads)
+
+
+def test_execute_code_roundtrip(small_cluster, monkeypatch):
+    """The reference's deliberate remote-execution verb (reference
+    bqueryd/worker.py:250-267) — here gated behind an explicit env flag."""
+    monkeypatch.setenv("BQUERYD_TPU_ENABLE_EXECUTE_CODE", "1")
+    result = small_cluster["rpc"].execute_code(
+        function="math.gcd", args=[12, 18], wait=True
+    )
+    assert result == 6
+
+
+def test_execute_code_direct_kwargs(small_cluster, monkeypatch):
+    """Keywords other than function/args/kwargs/wait go to the function."""
+    monkeypatch.setenv("BQUERYD_TPU_ENABLE_EXECUTE_CODE", "1")
+    result = small_cluster["rpc"].execute_code(
+        function="fnmatch.fnmatch", name="shard_3.bcolzs", pat="shard_*",
+        wait=True,
+    )
+    assert result is True
+
+
+def test_execute_code_disabled_by_default(small_cluster, monkeypatch):
+    from bqueryd_tpu.rpc import RPCError
+
+    monkeypatch.delenv("BQUERYD_TPU_ENABLE_EXECUTE_CODE", raising=False)
+    with pytest.raises(RPCError, match="execute_code disabled"):
+        small_cluster["rpc"].execute_code(
+            function="math.gcd", args=[12, 18], wait=True
+        )
+
+
+def test_dead_worker_culled_and_rejoins(tmp_path, mem_store_url):
+    """A worker that dies silently (no StopMessage) is culled after
+    dead_worker_timeout and dropped from files_map (reference
+    bqueryd/controller.py:548-552); a later heartbeat re-registers it."""
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    df = pd.DataFrame({"g": np.arange(10), "v": np.arange(10)})
+    ctable.fromdataframe(df, str(tmp_path / "t.bcolzs"))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=0.5,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    threads = _start(controller, worker)
+    try:
+        wait_until(
+            lambda: "t.bcolzs" in controller.files_map, desc="registration"
+        )
+        # crash the worker: no StopMessage, no heartbeats, just silence
+        worker.stop = lambda: None
+        worker.running = False
+        wait_until(
+            lambda: not controller.worker_map,
+            timeout=10,
+            desc="silent worker culled",
+        )
+        assert not controller.files_map.get("t.bcolzs")
+
+        # a restarted worker (fresh identity, same files) is picked up again
+        worker2 = WorkerNode(
+            coordination_url=mem_store_url,
+            data_dir=str(tmp_path),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.1,
+            poll_timeout=0.05,
+        )
+        threads += _start(worker2)
+        wait_until(
+            lambda: "t.bcolzs" in controller.files_map
+            and controller.files_map["t.bcolzs"],
+            desc="replacement worker registered",
+        )
+    finally:
+        _stop([controller, worker, locals().get("worker2")], threads)
+
+
+def test_controller_peering_and_killall(tmp_path, mem_store_url):
+    """Two controllers on one store discover each other via the membership
+    set + gossip (reference bqueryd/controller.py:77-106) and killall fans
+    out to peers (reference bqueryd/controller.py:510-516)."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+
+    a = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path / "a"),
+        heartbeat_interval=0.1,
+    )
+    b = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path / "b"),
+        heartbeat_interval=0.1,
+    )
+    threads = _start(a, b)
+    try:
+        wait_until(
+            lambda: b.address in a.others and a.address in b.others,
+            desc="mutual peer discovery",
+        )
+        rpc = RPC(
+            coordination_url=mem_store_url,
+            address=a.address,
+            timeout=30,
+            loglevel=logging.WARNING,
+        )
+        info = rpc.info()
+        assert b.address in info["others"]
+        rpc.killall()
+        wait_until(
+            lambda: not a.running and not b.running,
+            desc="killall reached both controllers",
+        )
+        # both unregistered from the membership set
+        from bqueryd_tpu import REDIS_SET_KEY
+        from bqueryd_tpu.coordination import coordination_store
+
+        wait_until(
+            lambda: not coordination_store(mem_store_url).smembers(
+                REDIS_SET_KEY
+            ),
+            desc="membership set emptied",
+        )
+    finally:
+        _stop([a, b], threads)
+
+
+def test_memory_watchdog_stops_over_limit_worker(tmp_path, mem_store_url):
+    """RSS above the limit (and caches shed without relief) stops the loop so
+    a supervisor can restart the process (reference bqueryd/worker.py:232-241,
+    2 GB cap)."""
+    from bqueryd_tpu.worker import WorkerNode
+
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=True,
+        memory_limit_mb=1,  # any real process RSS exceeds this
+    )
+    worker.running = True
+    worker._check_mem()
+    assert worker.running is False
+    worker.socket.close()
+
+
+def test_memory_watchdog_unmeasurable_shed_still_stops(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """If the post-shed RSS read fails, the pre-shed over-limit reading wins
+    and the worker still restarts (no silent disable of the safety net)."""
+    from bqueryd_tpu.worker import WorkerNode
+
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=True,
+        memory_limit_mb=1,
+    )
+    monkeypatch.setattr(worker, "_shed_caches", lambda: None)
+    worker.running = True
+    worker._check_mem()
+    assert worker.running is False
+    worker.socket.close()
+
+
+def test_memory_watchdog_shed_recovery_keeps_running(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """If shedding caches brings RSS back under the limit, the worker keeps
+    serving instead of restarting."""
+    from bqueryd_tpu.worker import WorkerNode
+
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=True,
+        memory_limit_mb=1,
+    )
+    monkeypatch.setattr(worker, "_shed_caches", lambda: 0.5)
+    worker.running = True
+    worker._check_mem()
+    assert worker.running is True
+    worker.socket.close()
