@@ -82,7 +82,13 @@ def build_dataset():
 
 def start_cluster():
     """Controller + one calc worker in-process (threads as nodes, the
-    reference's own benchmark/test topology) over real zmq sockets."""
+    reference's own benchmark/test topology) over real zmq sockets.
+
+    The worker's result cache is disabled: repeated identical queries would
+    otherwise be served from memory and the benchmark would measure a dict
+    lookup, not the engine (the kernel/storage caches stay on — they are the
+    steady-state serving path being measured)."""
+    os.environ["BQUERYD_TPU_RESULT_CACHE_BYTES"] = "0"
     from bqueryd_tpu.controller import ControllerNode
     from bqueryd_tpu.rpc import RPC
     from bqueryd_tpu.worker import WorkerNode

@@ -37,7 +37,6 @@ and H2D entirely and costs one compiled kernel dispatch.
 """
 
 import functools
-import hashlib
 import os
 
 import numpy as np
@@ -98,25 +97,12 @@ def _stored_dtype(tables, col):
     return np.result_type(*dts)
 
 
-def _freeze(value):
-    """Canonical, collision-free cache-key form of a where-term value
-    (repr() is ambiguous for numpy arrays, which truncate their repr)."""
-    if isinstance(value, np.ndarray):
-        return ("ndarray", value.dtype.str, value.shape,
-                hashlib.sha1(value.tobytes()).hexdigest())
-    if isinstance(value, (list, tuple)):
-        return ("seq", tuple(_freeze(v) for v in value))
-    if isinstance(value, (set, frozenset)):
-        return ("set", tuple(sorted((_freeze(v) for v in value), key=repr)))
-    if isinstance(value, np.generic):
-        return value.item()
-    return value
-
-
 def _where_signature(query):
     """Hashable, canonical identity of a query's row-filter."""
+    from bqueryd_tpu.models.query import freeze_value
+
     return (
-        tuple(_freeze(term) for term in (query.where_terms or [])),
+        freeze_value(query.where_terms or []),
         query.expand_filter_column,
     )
 
@@ -138,7 +124,15 @@ def _table_key(table):
     would let a new table hit a dead table's cached blocks)."""
     try:
         st = os.stat(os.path.join(table.rootdir, "meta.json"))
-        return (os.path.realpath(table.rootdir), st.st_mtime_ns, int(table.nrows))
+        # st_ino closes the same-mtime rewrite window: meta.json is written
+        # atomically (tempfile + rename), so every activation yields a fresh
+        # inode even when the timestamp granularity would hide the change
+        return (
+            os.path.realpath(table.rootdir),
+            st.st_ino,
+            st.st_mtime_ns,
+            int(table.nrows),
+        )
     except (OSError, TypeError):
         token = getattr(table, "_bqueryd_cache_token", None)
         if token is None:

@@ -326,6 +326,9 @@ class WorkerBase:
         executor = getattr(self, "_mesh_executor", None)
         if executor is not None:
             executor.clear_caches()
+        result_cache = getattr(self, "_result_cache", None)
+        if result_cache:
+            result_cache.clear()
         gc.collect()
         try:
             import psutil
@@ -345,6 +348,7 @@ class WorkerNode(WorkerBase):
         super().__init__(*args, **kw)
         self._engine = None
         self._mesh_executor = None
+        self._result_cache = None
 
     @property
     def engine(self):
@@ -361,6 +365,31 @@ class WorkerNode(WorkerBase):
 
             self._mesh_executor = MeshQueryExecutor()
         return self._mesh_executor
+
+    @property
+    def result_cache(self):
+        """Serialized-result cache keyed by (table identities, query
+        signature).  Table identity includes the shard's meta.json mtime, so
+        activation of new data invalidates naturally — a repeated query on
+        unchanged shards costs one dict lookup, no kernel dispatch.  Bounded
+        by BQUERYD_TPU_RESULT_CACHE_BYTES (0 disables)."""
+        if self._result_cache is None:
+            from bqueryd_tpu.utils.cache import BytesCappedCache
+
+            try:
+                cap = int(
+                    os.environ.get(
+                        "BQUERYD_TPU_RESULT_CACHE_BYTES", 256 * 1024**2
+                    )
+                )
+            except ValueError:
+                self.logger.warning(
+                    "unparseable BQUERYD_TPU_RESULT_CACHE_BYTES, cache off"
+                )
+                cap = 0
+            self._result_cache = BytesCappedCache(cap) if cap > 0 else False
+        # explicit False check: an EMPTY BytesCappedCache is len()-falsy
+        return None if self._result_cache is False else self._result_cache
 
     def _execute(self, tables, query, timer):
         """Psum-mergeable aggregations (any shard count) -> mesh executor
@@ -414,9 +443,24 @@ class WorkerNode(WorkerBase):
                 if not os.path.exists(rootdir):
                     raise ValueError(f"Path {rootdir} does not exist")
                 tables.append(ctable(rootdir, mode="r", auto_cache=True))
-        payload = self._execute(tables, query, timer)
-        with timer.phase("serialize"):
-            data = payload.to_bytes()
+        cache = self.result_cache
+        cache_key = None
+        data = None
+        if cache is not None:
+            from bqueryd_tpu.parallel.executor import _table_key
+
+            cache_key = (
+                tuple(_table_key(t) for t in tables), query.signature()
+            )
+            data = cache.get(cache_key)
+            if data is not None:
+                timer.timings["result_cache"] = 0.0
+        if data is None:
+            payload = self._execute(tables, query, timer)
+            with timer.phase("serialize"):
+                data = payload.to_bytes()
+            if cache is not None and len(data) <= cache.max_bytes // 8:
+                cache.put(cache_key, data, nbytes=len(data))
         # a result comparable to the worker's memory budget (1/32 of the
         # restart limit, 64 MB at the default 2 GB) means the query caches
         # are the next thing to evict

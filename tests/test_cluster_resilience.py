@@ -27,7 +27,8 @@ def _start(*nodes):
 
 def _stop(nodes, threads):
     for node in nodes:
-        node.running = False
+        if node is not None:  # a test may fail before creating late nodes
+            node.running = False
     for t in threads:
         t.join(timeout=5)
 

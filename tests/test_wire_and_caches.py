@@ -1,14 +1,17 @@
 """Wire narrowing, exact int64 limb sums, and executor cache behavior."""
 
+import logging
+import os
+import time
+
 import numpy as np
 import pandas as pd
 import pytest
 
-from bqueryd_tpu.models.query import GroupByQuery
+from bqueryd_tpu.models.query import GroupByQuery, freeze_value as _freeze
 from bqueryd_tpu.parallel.executor import (
     MeshQueryExecutor,
     _codes_dtype,
-    _freeze,
     _where_signature,
     _wire_dtype,
     make_mesh,
@@ -142,6 +145,82 @@ def test_pallas_kernel_matches_xla_path(op, g, monkeypatch):
             xla["aggs"][0][key], pallas["aggs"][0][key],
             err_msg=f"op={op} partial={key}",
         )
+
+
+def _worker_for(tmp_path, mem_store_url):
+    from bqueryd_tpu.worker import WorkerNode
+
+    return WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+    )
+
+
+def _calc_msg(filenames):
+    from bqueryd_tpu.messages import CalcMessage
+
+    msg = CalcMessage({"payload": "groupby", "token": "00"})
+    msg.set_args_kwargs(
+        [filenames, ["g"], [["v", "sum", "v"]], []], {}
+    )
+    return msg
+
+
+def test_result_cache_hit_and_activation_invalidation(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """A repeated identical query is served from the worker's result cache
+    (no engine execution); rewriting the shard (two-phase activation bumps
+    meta.json's mtime) invalidates the entry."""
+    df = pd.DataFrame({"g": np.arange(20) % 3, "v": np.arange(20)})
+    ctable.fromdataframe(df, str(tmp_path / "t.bcolzs"))
+    worker = _worker_for(tmp_path, mem_store_url)
+    try:
+        calls = []
+        real_execute = worker._execute
+        monkeypatch.setattr(
+            worker, "_execute",
+            lambda *a, **kw: calls.append(1) or real_execute(*a, **kw),
+        )
+        first = worker.handle_work(_calc_msg(["t.bcolzs"]))
+        second = worker.handle_work(_calc_msg(["t.bcolzs"]))
+        assert calls == [1], "second query must be served from cache"
+        assert first["data"] == second["data"]
+
+        # activation rewrites the table: meta.json is written atomically
+        # (temp + rename), so the table identity changes via the fresh inode
+        # even within filesystem timestamp granularity — no mtime bump needed
+        df2 = pd.DataFrame({"g": np.arange(20) % 3, "v": np.arange(20) * 10})
+        import shutil
+
+        shutil.rmtree(str(tmp_path / "t.bcolzs"))
+        ctable.fromdataframe(df2, str(tmp_path / "t.bcolzs"))
+        third = worker.handle_work(_calc_msg(["t.bcolzs"]))
+        assert calls == [1, 1], "rewritten shard must recompute"
+        assert third["data"] != first["data"]
+    finally:
+        worker.socket.close()
+
+
+def test_result_cache_disabled_by_env(tmp_path, mem_store_url, monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_RESULT_CACHE_BYTES", "0")
+    df = pd.DataFrame({"g": np.arange(6) % 2, "v": np.arange(6)})
+    ctable.fromdataframe(df, str(tmp_path / "t.bcolzs"))
+    worker = _worker_for(tmp_path, mem_store_url)
+    try:
+        calls = []
+        real_execute = worker._execute
+        monkeypatch.setattr(
+            worker, "_execute",
+            lambda *a, **kw: calls.append(1) or real_execute(*a, **kw),
+        )
+        worker.handle_work(_calc_msg(["t.bcolzs"]))
+        worker.handle_work(_calc_msg(["t.bcolzs"]))
+        assert calls == [1, 1], "cache disabled: every query executes"
+    finally:
+        worker.socket.close()
 
 
 def test_wire_dtype_narrows_by_stats(shard_tables):
