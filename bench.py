@@ -1,10 +1,19 @@
 """Benchmark: the full framework vs the reference architecture, end to end.
 
-Implements BASELINE.md config 2 (the headline): a 10 M-row NYC-taxi-shaped
-dataset in 10 ``.bcolzs`` shards, ``groupby passenger_count ->
-sum(fare_amount)`` (int64 cents, bit-exact), measured through the REAL stack:
-zmq RPC client -> controller -> calc worker -> mesh executor (shard_map
-segment partials + psum merge) -> reply.
+Implements every BASELINE.md config on a 10 M-row NYC-taxi-shaped dataset in
+10 ``.bcolzs`` shards, measured through the REAL stack: zmq RPC client ->
+controller -> calc worker -> mesh executor (MXU one-hot groupby kernel +
+psum merge) -> reply.  The headline line (config 2: 10-shard groupby-sum) is
+what the driver records; the other configs ride in ``detail.configs``.
+
+Configs (BASELINE.md "Benchmark configs to implement and measure"):
+
+1. ``single``    single-shard groupby_sum(passenger_count -> fare_amount)
+2. ``sharded``   the same over all 10 shards, controller merge  [HEADLINE]
+3. ``multikey``  groupby (VendorID, payment_type) with sum+count+mean
+4. ``filtered``  where trip_distance > 5.0 pushdown + groupby_sum
+5. ``highcard``  groupby (PULocationID x DOLocationID) — ~70k groups,
+                 exercises the scatter fallback past the MXU path's limit
 
 ``vs_baseline`` is speedup over a faithful CPU re-creation of the reference's
 dataflow (the reference publishes no numbers, SURVEY.md §6, so its
@@ -17,11 +26,15 @@ result (reference bqueryd/worker.py:335-346), tar-of-tars at the controller
 (reference bqueryd/controller.py:186-211), then untar + concat + re-groupby
 client-side (reference bqueryd/rpc.py:150-173).
 
-Prints ONE JSON line: {"metric", "value" (rows/s through the framework),
-"unit", "vs_baseline"}.
+Correctness gates: integer aggregates must match the baseline bit-for-bit;
+float means within 1e-6 relative.
+
+Prints ONE JSON line: {"metric", "value" (rows/s through the framework on
+the headline), "unit", "vs_baseline", "detail"}.
 
 Env knobs: BENCH_ROWS (default 10_000_000), BENCH_SHARDS (10),
-BENCH_REPEATS (3), BENCH_DATA_DIR (default /tmp/bqueryd_tpu_bench).
+BENCH_REPEATS (3), BENCH_DATA_DIR (default /tmp/bqueryd_tpu_bench),
+BENCH_CONFIGS (comma list, default all).
 """
 
 import io
@@ -40,16 +53,22 @@ ROWS = int(os.environ.get("BENCH_ROWS", 10_000_000))
 SHARDS = int(os.environ.get("BENCH_SHARDS", 10))
 REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
 DATA_DIR = os.environ.get("BENCH_DATA_DIR", "/tmp/bqueryd_tpu_bench")
+CONFIGS = [
+    c
+    for c in os.environ.get(
+        "BENCH_CONFIGS", "single,sharded,multikey,filtered,highcard"
+    ).split(",")
+    if c
+]
 
-GROUP_COL = "passenger_count"
-MEASURE_COL = "fare_amount"
+HEADLINE = "sharded"
 
 
 def build_dataset():
     """Write the sharded taxi-like dataset once; reuse across runs."""
     from bqueryd_tpu.storage.ctable import ctable
 
-    stamp = os.path.join(DATA_DIR, f"ready_{ROWS}_{SHARDS}")
+    stamp = os.path.join(DATA_DIR, f"ready_v2_{ROWS}_{SHARDS}")
     names = [f"taxi_{i}.bcolzs" for i in range(SHARDS)]
     if not os.path.exists(stamp):
         import shutil
@@ -64,10 +83,20 @@ def build_dataset():
             rows = per + (ROWS % SHARDS if i == SHARDS - 1 else 0)
             df = pd.DataFrame(
                 {
-                    GROUP_COL: rng.randint(1, 10, rows).astype(np.int64),
+                    "passenger_count": rng.randint(1, 10, rows).astype(
+                        np.int64
+                    ),
                     # integer cents: int64 end-to-end, the north-star
                     # bit-exactness axis
-                    MEASURE_COL: rng.randint(250, 20000, rows).astype(
+                    "fare_amount": rng.randint(250, 20000, rows).astype(
+                        np.int64
+                    ),
+                    "VendorID": rng.randint(1, 3, rows).astype(np.int64),
+                    "payment_type": rng.randint(1, 6, rows).astype(np.int64),
+                    "PULocationID": rng.randint(1, 266, rows).astype(
+                        np.int64
+                    ),
+                    "DOLocationID": rng.randint(1, 266, rows).astype(
                         np.int64
                     ),
                     "trip_distance": (rng.random(rows) * 30).astype(
@@ -78,6 +107,50 @@ def build_dataset():
             ctable.fromdataframe(df, os.path.join(DATA_DIR, name))
         open(stamp, "w").close()
     return names
+
+
+# config -> (filenames_slice, groupby_cols, agg_list, where_terms)
+def config_query(name, names):
+    if name == "single":
+        return (
+            names[:1],
+            ["passenger_count"],
+            [["fare_amount", "sum", "fare_amount"]],
+            [],
+        )
+    if name == "sharded":
+        return (
+            names,
+            ["passenger_count"],
+            [["fare_amount", "sum", "fare_amount"]],
+            [],
+        )
+    if name == "multikey":
+        return (
+            names,
+            ["VendorID", "payment_type"],
+            [
+                ["fare_amount", "sum", "fare_sum"],
+                ["fare_amount", "count", "n"],
+                ["trip_distance", "mean", "dist_mean"],
+            ],
+            [],
+        )
+    if name == "filtered":
+        return (
+            names,
+            ["passenger_count"],
+            [["fare_amount", "sum", "fare_amount"]],
+            [["trip_distance", ">", 5.0]],
+        )
+    if name == "highcard":
+        return (
+            names,
+            ["PULocationID", "DOLocationID"],
+            [["fare_amount", "sum", "fare_amount"]],
+            [],
+        )
+    raise ValueError(name)
 
 
 def start_cluster():
@@ -125,25 +198,45 @@ def start_cluster():
     return rpc, (controller, worker), threads
 
 
-def reference_shaped_baseline(names):
+def _pandas_agg(df, groupby_cols, agg_list):
+    named = {}
+    for in_col, op, out_col in agg_list:
+        pandas_op = {"count": "count", "sum": "sum", "mean": "mean"}[op]
+        named[out_col] = (in_col, pandas_op)
+    return df.groupby(groupby_cols, as_index=False).agg(**named)
+
+
+def reference_shaped_baseline(names, groupby_cols, agg_list, where_terms):
     """One query through the reference's dataflow shape on CPU (see module
     docstring); returns (wall_seconds, result_df)."""
     import pandas as pd
 
     from bqueryd_tpu.storage.ctable import ctable
 
+    in_cols = sorted(
+        {c for c, _, _ in agg_list}
+        | set(groupby_cols)
+        | {t[0] for t in where_terms}
+    )
     t0 = time.perf_counter()
     shard_tars = []
     for name in names:
         # per-query single-threaded decode, no decoded cache (bcolz behavior)
         t = ctable(os.path.join(DATA_DIR, name), auto_cache=False, nthreads=1)
-        df = pd.DataFrame(
-            {
-                GROUP_COL: t.column_raw(GROUP_COL),
-                MEASURE_COL: t.column_raw(MEASURE_COL),
-            }
-        )
-        part = df.groupby(GROUP_COL, as_index=False)[MEASURE_COL].sum()
+        df = pd.DataFrame({c: t.column_raw(c) for c in in_cols})
+        for col, op, val in where_terms:
+            assert op == ">"
+            df = df[df[col] > val]
+        # shard partials merge with sum/count partials like the client-side
+        # re-groupby does (reference bqueryd/rpc.py:150-173)
+        part_aggs = []
+        for in_col, op, out_col in agg_list:
+            if op == "mean":
+                part_aggs.append([in_col, "sum", out_col + "__sum"])
+                part_aggs.append([in_col, "count", out_col + "__n"])
+            else:
+                part_aggs.append([in_col, op, out_col])
+        part = _pandas_agg(df, groupby_cols, part_aggs)
         # worker: result table -> tar bytes (reference bqueryd/worker.py:335-346)
         buf = io.BytesIO()
         with tarfile.open(mode="w", fileobj=buf) as tar:
@@ -168,65 +261,106 @@ def reference_shaped_baseline(names):
             with tarfile.open(mode="r", fileobj=io.BytesIO(inner)) as shard:
                 for m2 in shard.getmembers():
                     parts.append(pickle.loads(shard.extractfile(m2).read()))
-    merged = (
-        pd.concat(parts, ignore_index=True)
-        .groupby(GROUP_COL, as_index=False)[MEASURE_COL]
-        .sum()
-    )
+    cat = pd.concat(parts, ignore_index=True)
+    sums = cat.groupby(groupby_cols, as_index=False).sum()
+    merged = sums[groupby_cols].copy()
+    for in_col, op, out_col in agg_list:
+        if op == "mean":
+            merged[out_col] = (
+                sums[out_col + "__sum"] / sums[out_col + "__n"]
+            )
+        else:
+            merged[out_col] = sums[out_col]
     return time.perf_counter() - t0, merged
+
+
+def check_result(result_df, base_df, groupby_cols, agg_list, config):
+    """Integer aggregates bit-exact vs the baseline; float means close."""
+    import pandas as pd
+
+    r = result_df.sort_values(groupby_cols).reset_index(drop=True)
+    b = base_df.sort_values(groupby_cols).reset_index(drop=True)
+    assert len(r) == len(b), f"{config}: row count {len(r)} != {len(b)}"
+    for col in groupby_cols:
+        assert (
+            r[col].astype(np.int64) == b[col].astype(np.int64)
+        ).all(), f"{config}: key column {col} mismatch"
+    for _, op, out_col in agg_list:
+        if op in ("sum", "count") and b[out_col].dtype.kind in "iu":
+            assert (
+                r[out_col].astype(np.int64) == b[out_col].astype(np.int64)
+            ).all(), f"{config}: bit-exactness failure in {out_col}"
+        else:
+            rv = r[out_col].astype(np.float64).to_numpy()
+            bv = b[out_col].astype(np.float64).to_numpy()
+            # float32 inputs summed in different orders (MXU blocks vs
+            # pandas pairwise): compare to f32-accumulation precision, with
+            # an absolute floor scaled to the values' magnitude
+            atol = 1e-7 * float(np.abs(bv).max(initial=1.0))
+            ok = np.allclose(rv, bv, rtol=1e-4, atol=atol)
+            assert ok, f"{config}: float mismatch in {out_col}"
 
 
 def main():
     t_start = time.time()
     names = build_dataset()
     rpc, nodes, threads = start_cluster()
+    results = {}
     try:
         import jax
 
-        # warmup: storage decode, XLA compile, HBM/alignment caches
-        result = rpc.groupby(
-            names, [GROUP_COL], [[MEASURE_COL, "sum", MEASURE_COL]], []
+        for config in CONFIGS:
+            files, gcols, aggs, where = config_query(config, names)
+            nrows = ROWS * len(files) // SHARDS
+            # warmup: storage decode, XLA compile, HBM/alignment caches
+            rpc.groupby(files, gcols, aggs, where)
+            walls = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                result = rpc.groupby(files, gcols, aggs, where)
+                walls.append(time.perf_counter() - t0)
+            our_wall = min(walls)
+
+            # symmetric measurement: one warmup (page cache) + REPEATS timed
+            # for the baseline, same as the framework side
+            reference_shaped_baseline(files, gcols, aggs, where)
+            base_walls, base_df = [], None
+            for _ in range(REPEATS):
+                wall, base_df = reference_shaped_baseline(
+                    files, gcols, aggs, where
+                )
+                base_walls.append(wall)
+            base_wall = min(base_walls)
+            check_result(result, base_df, gcols, aggs, config)
+            results[config] = {
+                "rows": nrows,
+                "groups": len(base_df),
+                "framework_wall_s": round(our_wall, 4),
+                "reference_shaped_wall_s": round(base_wall, 4),
+                "rows_per_sec": round(nrows / our_wall, 1),
+                "speedup": round(base_wall / our_wall, 3),
+            }
+
+        head_name = HEADLINE if HEADLINE in results else CONFIGS[0]
+        head = results[head_name]
+        metric = (
+            "taxi_groupby_sum_10shard_e2e_rows_per_sec"
+            if head_name == HEADLINE
+            else f"taxi_groupby_{head_name}_e2e_rows_per_sec"
         )
-        ours = []
-        for _ in range(REPEATS):
-            t0 = time.perf_counter()
-            result = rpc.groupby(
-                names, [GROUP_COL], [[MEASURE_COL, "sum", MEASURE_COL]], []
-            )
-            ours.append(time.perf_counter() - t0)
-        our_wall = min(ours)
-
-        base_walls, base_df = [], None
-        for _ in range(REPEATS):
-            wall, base_df = reference_shaped_baseline(names)
-            base_walls.append(wall)
-        base_wall = min(base_walls)
-
-        # correctness gate: int64 bit-exact against the baseline's answer
-        got = dict(
-            zip(
-                (int(k) for k in result[GROUP_COL]),
-                (int(v) for v in result[MEASURE_COL]),
-            )
-        )
-        for _, row in base_df.iterrows():
-            key, val = int(row[GROUP_COL]), int(row[MEASURE_COL])
-            assert got[key] == val, f"bit-exactness failure at key {key}"
-
         print(
             json.dumps(
                 {
-                    "metric": "taxi_groupby_sum_10shard_e2e_rows_per_sec",
-                    "value": round(ROWS / our_wall, 1),
+                    "metric": metric,
+                    "value": head["rows_per_sec"],
                     "unit": "rows/s",
-                    "vs_baseline": round(base_wall / our_wall, 3),
+                    "vs_baseline": head["speedup"],
                     "detail": {
                         "rows": ROWS,
                         "shards": SHARDS,
-                        "framework_wall_s": round(our_wall, 4),
-                        "reference_shaped_wall_s": round(base_wall, 4),
                         "backend": jax.default_backend(),
                         "n_devices": len(jax.devices()),
+                        "configs": results,
                         "total_s": round(time.time() - t_start, 1),
                     },
                 }

@@ -1,8 +1,6 @@
 """Wire narrowing, exact int64 limb sums, and executor cache behavior."""
 
 import logging
-import os
-import time
 
 import numpy as np
 import pandas as pd
