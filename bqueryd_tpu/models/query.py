@@ -110,6 +110,29 @@ class GroupByQuery:
         return [a[2] for a in self.agg_list]
 
 
+def _group_value_sets(group_codes, value_codes, value_uniques, n_groups,
+                      mask=None):
+    """object-ndarray[n_groups] of each group's sorted distinct values.
+
+    Null group keys, null values (code < 0, e.g. NaN — matching pandas
+    ``nunique(dropna=True)``), and masked-out rows contribute nothing."""
+    valid = (group_codes >= 0) & (value_codes >= 0)
+    if mask is not None:
+        valid &= mask
+    nv = max(len(value_uniques), 1)
+    pairs = np.unique(
+        group_codes[valid].astype(np.int64) * nv + value_codes[valid]
+    )
+    g_of = pairs // nv
+    v_of = pairs % nv
+    bounds = np.searchsorted(g_of, np.arange(n_groups + 1))
+    sets = np.empty(n_groups, dtype=object)
+    # one gather + boundary split; consumers (len / union-merge) don't need
+    # per-set value order, so no per-group sort
+    sets[:] = np.split(np.asarray(value_uniques)[v_of], bounds[1:-1])
+    return sets
+
+
 class ResultPayload(dict):
     """Wire form of a shard/worker result; a plain dict for pickling."""
 
@@ -255,21 +278,30 @@ class QueryEngine:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)
                 if op == "count_distinct":
-                    vcodes, vuniques = ops.factorize(vals)
-                    counts = ops.groupby_count_distinct(
-                        dense.astype(np.int32),
-                        vcodes,
-                        n_groups=n_groups,
-                        n_values=max(len(vuniques), 1),
-                        mask=mask_arr,
-                    )
+                    # ship the per-group distinct VALUE SETS, not counts:
+                    # sets union exactly across shards/workers, where the
+                    # reference's forced-'sum' client merge double-counts
+                    # values that span shards (reference bqueryd/rpc.py:171).
+                    # _key_codes resolves dict-encoded and datetime columns
+                    # to their actual values — per-shard dictionary codes
+                    # live in incompatible code spaces and must never cross
+                    # a shard boundary raw.
+                    vcodes, vuniques = self._key_codes(table, in_col)
+                    agg_parts[i] = {
+                        "distinct_sets": _group_value_sets(
+                            np.asarray(dense), np.asarray(vcodes),
+                            np.asarray(vuniques), n_groups, mask_arr,
+                        )
+                    }
                 elif op == "sorted_count_distinct":
+                    # run-boundary counts are inherently per-shard (the sort
+                    # order is local); cross-shard merge stays additive
                     counts = ops.groupby_sorted_count_distinct(
                         dense.astype(np.int32), vals, n_groups, mask_arr
                     )
+                    agg_parts[i] = {"distinct": np.asarray(counts)}
                 else:
                     raise ValueError(f"unknown aggregation op {op!r}")
-                agg_parts[i] = {"distinct": np.asarray(counts)}
 
         with self._phase("collect"):
             present = rows > 0

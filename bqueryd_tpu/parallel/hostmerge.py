@@ -15,10 +15,11 @@ per SURVEY.md §7.4:
 * ``min``/``max`` merge as min/max, which the reference's forced-'sum' merge
   silently corrupted.
 
-Known reference-compatible limitation: ``count_distinct`` partials merge by
-addition across *workers* (distinct sets are not shipped), so values present
-on multiple workers are double-counted — exactly the reference's behaviour
-for values spanning shards.  Within one worker the count is exact.
+* ``count_distinct`` partials carry the per-group distinct VALUE SETS and
+  merge by union, so values spanning shards/workers are counted once — the
+  reference's forced-'sum' merge double-counts them.  (The deliberately
+  additive exception is ``sorted_count_distinct``: run counts are local to
+  each shard's sort order by definition.)
 """
 
 import numpy as np
@@ -29,6 +30,7 @@ _MERGE_RULES = {
     "distinct": np.add,
     "min": np.minimum,
     "max": np.maximum,
+    "distinct_sets": "union",  # handled specially in _merge_partials
 }
 
 
@@ -116,7 +118,24 @@ def _merge_partials(payloads):
                 (g, np.asarray(p["aggs"][ai][pname]))
                 for g, p in zip(group_of, payloads)
             ]
-            merged[pname] = scatter(rule, parts, parts[0][1].dtype)
+            if rule == "union":
+                # bucket every payload's set per global group, then ONE
+                # unique per group (incremental pairwise unions would re-sort
+                # the accumulated set payload-count times)
+                buckets = [[] for _ in range(n_global)]
+                for local_map, arr in parts:
+                    for g_local, g_global in enumerate(local_map):
+                        buckets[g_global].append(arr[g_local])
+                out = np.empty(n_global, dtype=object)
+                for g, bucket in enumerate(buckets):
+                    out[g] = (
+                        np.unique(np.concatenate(bucket))
+                        if bucket
+                        else np.empty(0)
+                    )
+                merged[pname] = out
+            else:
+                merged[pname] = scatter(rule, parts, parts[0][1].dtype)
         aggs.append(merged)
 
     # global key arrays in first-seen order
@@ -167,7 +186,13 @@ def finalize_table(merged):
             values = agg["sum"]
         elif op in ("count", "count_na"):
             values = agg["count"]
-        elif op in ("count_distinct", "sorted_count_distinct"):
+        elif op == "count_distinct":
+            values = np.fromiter(
+                (len(s) for s in agg["distinct_sets"]),
+                dtype=np.int64,
+                count=len(agg["distinct_sets"]),
+            )
+        elif op == "sorted_count_distinct":
             values = agg["distinct"]
         elif op in ("min", "max"):
             values = agg[op]

@@ -197,6 +197,98 @@ def test_groupby_with_filter(cluster, taxi_df):
     pd.testing.assert_frame_equal(got, expected, check_dtype=False)
 
 
+def test_count_distinct_sharded(cluster, taxi_df):
+    """Distinct counts can't psum-merge; the cluster must route them through
+    the per-shard gather path and still agree with pandas nunique."""
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    got = cluster["rpc"].groupby(
+        shard_names,
+        ["payment_type"],
+        [["passenger_count", "count_distinct", "nuniq"]],
+        [],
+    )
+    expected = (
+        taxi_df.groupby("payment_type")["passenger_count"]
+        .nunique()
+        .reset_index(name="nuniq")
+    )
+    got = got.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_count_distinct_string_column_across_shards(tmp_path, mem_store_url):
+    """Per-shard dictionaries encode the same string with different codes;
+    the distinct-set merge must union VALUES, not codes."""
+    import threading
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage import ctable as storage_ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    # shard 0 sees 'cash' first, shard 1 sees 'credit' first -> code spaces
+    # deliberately disagree
+    s0 = pd.DataFrame({"g": [1, 1, 2], "pay": ["cash", "credit", "cash"]})
+    s1 = pd.DataFrame({"g": [1, 2, 2], "pay": ["credit", "cash", "credit"]})
+    storage_ctable.fromdataframe(s0, str(tmp_path / "p0.bcolzs"))
+    storage_ctable.fromdataframe(s1, str(tmp_path / "p1.bcolzs"))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path), heartbeat_interval=0.1,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url, data_dir=str(tmp_path),
+        loglevel=logging.WARNING, restart_check=False,
+        heartbeat_interval=0.1, poll_timeout=0.05,
+    )
+    threads = [
+        threading.Thread(target=n.go, daemon=True)
+        for n in (controller, worker)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        wait_until(lambda: len(controller.files_map) >= 2, desc="shards")
+        rpc = RPC(coordination_url=mem_store_url, timeout=30,
+                  loglevel=logging.WARNING)
+        got = rpc.groupby(
+            ["p0.bcolzs", "p1.bcolzs"], ["g"],
+            [["pay", "count_distinct", "nuniq"]], [],
+        ).sort_values("g").reset_index(drop=True)
+        full = pd.concat([s0, s1], ignore_index=True)
+        exp = full.groupby("g")["pay"].nunique().reset_index(name="nuniq")
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    finally:
+        for n in (controller, worker):
+            n.running = False
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_raw_rows_mode_sharded(cluster, taxi_df):
+    """aggregate=False returns the filtered rows themselves, concatenated
+    across shards (reference bqueryd/worker.py:316-323 raw path)."""
+    shard_names = [f"taxi-{i}.bcolzs" for i in range(NR_SHARDS)]
+    got = cluster["rpc"].groupby(
+        shard_names,
+        ["payment_type"],
+        [["total_amount", "sum", "total_amount"]],
+        [("trip_distance", ">", 20.0)],
+        aggregate=False,
+    )
+    expected = taxi_df[taxi_df.trip_distance > 20.0]
+    assert len(got) == len(expected)
+    # same multiset of rows (shard order differs from source order)
+    got_s = got.sort_values(
+        ["payment_type", "total_amount"]
+    ).reset_index(drop=True)
+    exp_s = expected[["payment_type", "total_amount"]].sort_values(
+        ["payment_type", "total_amount"]
+    ).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_s, exp_s, check_dtype=False)
+
+
 def test_groupby_unknown_file_errors(cluster):
     from bqueryd_tpu.rpc import RPCError
 
