@@ -31,6 +31,44 @@ if _requested and "axon" not in _requested and "tpu" not in _requested:
         pass
 del _requested
 
+
+_distributed_initialized = False
+
+
+def maybe_init_distributed(logger=None):
+    """Join a multi-host JAX job when configured; no-op otherwise.
+
+    Set ``BQUERYD_TPU_DIST_COORDINATOR=host:port`` on every host of a pod
+    slice (plus ``BQUERYD_TPU_DIST_NPROCS`` / ``BQUERYD_TPU_DIST_PROC_ID``
+    off-TPU, where they can't be inferred) and the calc worker becomes one
+    process of a single multi-host JAX runtime: ``jax.devices()`` spans the
+    slice, the mesh executor's 1-D shard mesh covers every chip, and the
+    ``psum`` merge rides ICI within a host and DCN across hosts — the
+    framework's answer to the reference's one-process-per-core scaling
+    (reference misc/supervisor.conf:19-20).
+
+    Must run before the first JAX backend touch; the worker calls it at
+    construction time."""
+    global _distributed_initialized
+    coordinator = os.environ.get("BQUERYD_TPU_DIST_COORDINATOR")
+    if not coordinator or _distributed_initialized:
+        return False
+    kwargs = {"coordinator_address": coordinator}
+    if os.environ.get("BQUERYD_TPU_DIST_NPROCS"):
+        kwargs["num_processes"] = int(os.environ["BQUERYD_TPU_DIST_NPROCS"])
+    if os.environ.get("BQUERYD_TPU_DIST_PROC_ID"):
+        kwargs["process_id"] = int(os.environ["BQUERYD_TPU_DIST_PROC_ID"])
+    jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
+    if logger is not None:
+        logger.info(
+            "joined multi-host JAX job: process %d/%d, %d/%d devices local",
+            jax.process_index(), jax.process_count(),
+            len(jax.local_devices()), len(jax.devices()),
+        )
+    return True
+
+
 from bqueryd_tpu.ops.factorize import (  # noqa: E402
     factorize,
     factorize_device,

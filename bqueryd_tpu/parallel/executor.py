@@ -45,13 +45,31 @@ from bqueryd_tpu.models.query import GroupByQuery, ResultPayload
 
 
 def make_mesh(n_devices=None, axis_name="shards"):
-    """A 1-D mesh over the first ``n_devices`` local JAX devices."""
+    """A 1-D mesh over the first ``n_devices`` JAX devices.
+
+    In a multi-host job (``ops.maybe_init_distributed``) ``jax.devices()``
+    spans every host of the slice, so the shard mesh — and the psum merge —
+    covers all chips: ICI within a host, DCN across hosts."""
     import jax
 
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def _put(arr_np, sharding):
+    """Host->device placement that also works when the mesh spans hosts:
+    multi-host shardings reject a plain device_put of a host-global array,
+    so each process materializes only its addressable shards via callback
+    (every worker process computes the same global array)."""
+    import jax
+
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(
+            arr_np.shape, sharding, lambda idx: arr_np[idx]
+        )
+    return jax.device_put(arr_np, sharding)
 
 
 def _wire_dtype(tables, col):
@@ -365,7 +383,7 @@ class MeshQueryExecutor:
                     for d, mask in zip(dense, masks)
                 ]
                 packed = self._pack(folded, n_dev, cdt.type(-1), dtype=cdt)
-                codes_d = jax.device_put(packed, sharding)
+                codes_d = _put(packed, sharding)
                 self._hbm_cache.put(codes_key, codes_d)
 
         with self._phase("layout"):
@@ -381,7 +399,7 @@ class MeshQueryExecutor:
                     if wire is not None:
                         cols = [c.astype(wire, copy=False) for c in cols]
                     packed = self._pack(cols, n_dev, 0, dtype=wire)
-                    arr = jax.device_put(packed, sharding)
+                    arr = _put(packed, sharding)
                     self._hbm_cache.put(mkey, arr)
                 measures_d.append(arr)
 

@@ -349,6 +349,11 @@ class WorkerNode(WorkerBase):
         self._engine = None
         self._mesh_executor = None
         self._result_cache = None
+        # join a multi-host JAX job if configured (pod slice = one logical
+        # calc worker; must happen before any JAX backend touch)
+        from bqueryd_tpu import ops
+
+        ops.maybe_init_distributed(self.logger)
 
     @property
     def engine(self):
