@@ -52,6 +52,7 @@ POLLING_TIMEOUT = 0.5        # seconds
 DEAD_WORKER_TIMEOUT = 60.0   # cull workers silent longer than this
 HEARTBEAT_INTERVAL = 2.0     # store re-registration + peer sync period
 DISPATCH_TIMEOUT = 120.0     # re-queue in-flight work after this
+DISPATCH_HARD_TIMEOUT = 1800.0  # ...even if the worker still heartbeats
 MAX_DISPATCH_RETRIES = 2
 RUNFILE_DIR = os.environ.get("BQUERYD_TPU_RUNFILE_DIR", "/srv")
 
@@ -71,6 +72,7 @@ class ControllerNode:
         heartbeat_interval=HEARTBEAT_INTERVAL,
         dead_worker_timeout=DEAD_WORKER_TIMEOUT,
         dispatch_timeout=DISPATCH_TIMEOUT,
+        dispatch_hard_timeout=DISPATCH_HARD_TIMEOUT,
         port_range=(14300, 14400),
     ):
         import logging
@@ -82,6 +84,7 @@ class ControllerNode:
         self.heartbeat_interval = heartbeat_interval
         self.dead_worker_timeout = dead_worker_timeout
         self.dispatch_timeout = dispatch_timeout
+        self.dispatch_hard_timeout = max(dispatch_hard_timeout, dispatch_timeout)
 
         self.context = zmq.Context.instance()
         self.socket = self.context.socket(zmq.ROUTER)
@@ -205,11 +208,24 @@ class ControllerNode:
                 self.others.pop(addr, None)
 
     def free_dead_workers(self):
+        """Cull workers silent longer than ``dead_worker_timeout`` — but never
+        one we handed in-flight work younger than ``dispatch_timeout``: culling
+        it would drop its ``files_map`` entries and fail-fast the very query it
+        is busy computing (the round-1 benchmark failure).  A genuinely hung
+        worker is still reclaimed: its dispatch times out, the shard is
+        requeued, and with nothing in flight the cull proceeds next tick."""
         now = time.time()
         for worker_id, info in list(self.worker_map.items()):
-            if now - info.get("last_seen", now) > self.dead_worker_timeout:
-                self.logger.warning("culling dead worker %s", worker_id)
-                self.remove_worker(worker_id)
+            if now - info.get("last_seen", now) <= self.dead_worker_timeout:
+                continue
+            if any(
+                e["worker"] == worker_id
+                and now - e["sent_at"] <= self.dispatch_timeout
+                for e in self.inflight.values()
+            ):
+                continue
+            self.logger.warning("culling dead worker %s", worker_id)
+            self.remove_worker(worker_id)
 
     def remove_worker(self, worker_id):
         self.worker_map.pop(worker_id, None)
@@ -330,6 +346,9 @@ class ControllerNode:
             return
         if worker_id in self.worker_map:
             self.worker_map[worker_id]["busy"] = True
+            # a successful dispatch is proof of liveness: the send would have
+            # raised on a gone peer (ROUTER_MANDATORY)
+            self.worker_map[worker_id]["last_seen"] = time.time()
         token = msg.get("token")
         if token:
             self.inflight[token] = {
@@ -341,14 +360,43 @@ class ControllerNode:
             }
 
     def retry_stale_dispatches(self):
+        """Requeue in-flight work whose worker stopped heartbeating (after
+        ``dispatch_timeout``) or that exceeded ``dispatch_hard_timeout`` even
+        on a live worker.  A live, heartbeating worker inside the hard cap is
+        left alone — first-query XLA compilation on a TPU can legitimately
+        outlast ``dispatch_timeout``, and requeueing a shard that is still
+        being computed would double-execute it and then abort the parent
+        after MAX_DISPATCH_RETRIES."""
         now = time.time()
         for token, entry in list(self.inflight.items()):
-            if now - entry["sent_at"] > self.dispatch_timeout:
+            if token not in self.inflight:
+                continue  # already reclaimed by a remove_worker below
+            age = now - entry["sent_at"]
+            if age <= self.dispatch_timeout:
+                continue
+            winfo = self.worker_map.get(entry["worker"])
+            worker_alive = (
+                winfo is not None
+                and now - winfo.get("last_seen", 0.0) <= self.dead_worker_timeout
+            )
+            if worker_alive and age <= self.dispatch_hard_timeout:
+                continue
+            self.logger.warning(
+                "dispatch %s to %s timed out (age %.0fs, worker %s)",
+                token, entry["worker"],
+                age, "alive" if worker_alive else "dead",
+            )
+            self.inflight.pop(token)
+            self._requeue(entry)
+            if worker_alive:
+                # heartbeating but wedged past the hard cap: reclaim it fully
+                # (drop its files_map entries + requeue its other inflight)
+                # or it would sit busy-and-advertised forever, head-of-line
+                # blocking every query for files only it holds
                 self.logger.warning(
-                    "dispatch %s to %s timed out", token, entry["worker"]
+                    "worker %s hung past hard timeout, removing", entry["worker"]
                 )
-                self.inflight.pop(token)
-                self._requeue(entry)
+                self.remove_worker(entry["worker"])
 
     def _requeue(self, entry):
         msg = entry["msg"]
@@ -408,6 +456,27 @@ class ControllerNode:
         )
         now = time.time()
         if msg.isa(WorkerRegisterMessage):
+            if msg.get("liveness_only"):
+                # side-channel heartbeat from the worker's liveness thread:
+                # for a KNOWN worker refresh last_seen only — its data_files
+                # snapshot may lag the main loop's rescan, and dropping
+                # advertisements for a busy worker aborts its query.  For an
+                # UNKNOWN worker (controller restart while the worker's event
+                # loop is deep in a long handle_work) adopt the snapshot
+                # additively: without it the sole holder of a shard would look
+                # file-less until its main loop resumes, failing every query
+                # for that shard with 'no longer on any worker'.
+                known = self.worker_map.get(worker_id)
+                if known is not None:
+                    known["last_seen"] = now
+                else:
+                    info = dict(msg)
+                    info["last_seen"] = now
+                    info["busy"] = False
+                    self.worker_map[worker_id] = info
+                    for filename in info.get("data_files") or []:
+                        self.files_map.setdefault(filename, set()).add(worker_id)
+                return
             info = dict(msg)
             info["last_seen"] = now
             info["busy"] = self.worker_map.get(worker_id, {}).get("busy", False)

@@ -30,6 +30,7 @@ import random
 import signal
 import socket as socket_mod
 import sys
+import threading
 import time
 import traceback
 
@@ -101,8 +102,11 @@ class WorkerBase:
         self.data_files = []
         self.running = False
         self.start_time = time.time()
+        self._loop_started = self.start_time  # reset in go(), after warmup
         self.msg_count = 0
         self.last_heartbeat = 0.0
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
 
     # -- lifecycle ---------------------------------------------------------
     def go(self):
@@ -112,6 +116,8 @@ class WorkerBase:
         except ValueError:
             pass  # not the main thread (in-process test clusters)
         self.logger.info("starting %s worker %s", self.workertype, self.worker_id)
+        self._loop_started = time.time()  # fast-start anchor (post-warmup)
+        self._start_heartbeat_thread()
         while self.running:
             try:
                 self.heartbeat()
@@ -130,6 +136,9 @@ class WorkerBase:
         self.running = False
 
     def stop(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         for addr in list(self.controllers):
             try:
                 self.send(addr, StopMessage({"worker_id": self.worker_id}))
@@ -138,20 +147,73 @@ class WorkerBase:
         self.socket.close()
         self.logger.info("worker %s stopped", self.worker_id)
 
+    # -- liveness side-channel --------------------------------------------
+    def _start_heartbeat_thread(self):
+        """Broadcast WRMs from a dedicated thread so a long ``handle_work``
+        (first-query XLA compile, a 10 M-row H2D, a slow blob fetch) cannot
+        starve liveness and get this busy worker culled by the controller
+        (the round-1 benchmark failure mode; cf. the reference's
+        single-threaded WRM cycle, reference bqueryd/worker.py:131-143).
+
+        ZeroMQ sockets are single-thread-only, so the thread owns a private
+        DEALER socket per run; the controller keys worker liveness on the
+        ``worker_id`` *inside* the WRM, not the delivering socket's identity,
+        so heartbeats on this side channel refresh the same worker entry.
+        """
+        self._hb_stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"hb-{self.worker_id[:6]}",
+            daemon=True,
+        )
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self):
+        sock = self.context.socket(zmq.DEALER)
+        # distinct identity: this socket must never be addressed as the worker
+        sock.identity = (self.worker_id + ".hb").encode()
+        sock.setsockopt(zmq.LINGER, 0)
+        connected = set()
+        try:
+            while not self._hb_stop.is_set() and self.running:
+                try:
+                    self._sync_controller_connections(sock, connected)
+                    wrm = self.prepare_wrm()
+                    wrm["liveness_only"] = True  # files rescanned on main loop
+                    payload = wrm.to_json().encode()
+                    for addr in connected:
+                        try:
+                            sock.send_multipart([payload], zmq.NOBLOCK)
+                        except zmq.ZMQError:
+                            pass
+                except Exception:
+                    self.logger.debug("heartbeat thread tick failed", exc_info=True)
+                # re-broadcast well inside the controller's dead timeout
+                self._hb_stop.wait(min(self.heartbeat_interval, 10.0))
+        finally:
+            sock.close()
+
     # -- discovery / registration -----------------------------------------
-    def check_controllers(self):
+    def _sync_controller_connections(self, sock, connected):
+        """Reconcile ``sock``'s connections with the membership set; used by
+        both the main ROUTER socket and the liveness thread's DEALER socket
+        (each thread owns its socket + tracking set exclusively)."""
         current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
-        for addr in current - self.controllers:
+        for addr in current - connected:
             self.logger.debug("connecting to controller %s", addr)
-            self.socket.connect(addr)
-            self.controllers.add(addr)
-        for addr in self.controllers - current:
+            sock.connect(addr)
+            connected.add(addr)
+        for addr in connected - current:
             self.logger.debug("dropping dead controller %s", addr)
             try:
-                self.socket.disconnect(addr)
+                sock.disconnect(addr)
             except zmq.ZMQError:
                 pass
-            self.controllers.discard(addr)
+            connected.discard(addr)
+        return connected
+
+    def check_controllers(self):
+        self._sync_controller_connections(self.socket, self.controllers)
 
     def check_datafiles(self):
         found = []
@@ -181,7 +243,14 @@ class WorkerBase:
 
     def heartbeat(self):
         now = time.time()
-        if now - self.last_heartbeat < self.heartbeat_interval:
+        interval = self.heartbeat_interval
+        # fast start: the first WRM on a freshly connected ROUTER socket is
+        # dropped if the peer handshake hasn't finished (identity not yet
+        # routable), so rebroadcast every second until registration settles
+        # rather than waiting a full heartbeat_interval to become queryable
+        if now - self._loop_started < 10.0:
+            interval = min(interval, 1.0)
+        if now - self.last_heartbeat < interval:
             return
         self.last_heartbeat = now
         self.check_controllers()
@@ -354,6 +423,30 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu import ops
 
         ops.maybe_init_distributed(self.logger)
+
+    def go(self):
+        if os.environ.get("BQUERYD_TPU_WARMUP", "1") == "1":
+            self.warmup()
+        super().go()
+
+    def warmup(self):
+        """Prime the JAX backend (PJRT client init + a tiny kernel compile)
+        before serving, so the first real query's dispatch window pays only
+        its own shape's compile, not device bring-up.  Runs before the first
+        WRM broadcast: the worker is not advertised until it is ready."""
+        t0 = time.time()
+        try:
+            import numpy as np
+
+            from bqueryd_tpu import ops
+
+            codes = np.zeros(8, dtype=np.int32)
+            vals = np.ones(8, dtype=np.int64)
+            partials = ops.partial_tables(codes, (vals,), ("sum",), 4, None)
+            ops.finalize(partials, ("sum",))
+            self.logger.info("kernel warmup done in %.1fs", time.time() - t0)
+        except Exception:
+            self.logger.exception("kernel warmup failed (continuing)")
 
     @property
     def engine(self):

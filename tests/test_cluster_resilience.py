@@ -209,6 +209,163 @@ def test_controller_peering_and_killall(tmp_path, mem_store_url):
         _stop([a, b], threads)
 
 
+def test_busy_worker_outliving_dead_timeout_not_culled(tmp_path, mem_store_url):
+    """Work that outlives dead_worker_timeout still completes: the liveness
+    thread keeps heartbeating while handle_work blocks the event loop, so the
+    controller must neither cull the busy worker nor drop its files_map
+    entries mid-query (the round-1 benchmark failure: 'file(s) no longer on
+    any worker')."""
+    import time as time_mod
+
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    df = pd.DataFrame(
+        {"g": np.arange(20) % 4, "v": np.arange(20, dtype=np.int64)}
+    )
+    ctable.fromdataframe(df, str(tmp_path / "slow.bcolzs"))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=1.0,   # far below the query's runtime
+        dispatch_timeout=30.0,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.3,
+        poll_timeout=0.05,
+    )
+    # make the query block the worker's event loop well past the cull timeout
+    orig_handle_work = worker.handle_work
+
+    def slow_handle_work(msg):
+        time_mod.sleep(2.5)
+        return orig_handle_work(msg)
+
+    worker.handle_work = slow_handle_work
+
+    threads = _start(controller, worker)
+    try:
+        wait_until(
+            lambda: "slow.bcolzs" in controller.files_map, desc="registration"
+        )
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=30, loglevel=logging.WARNING
+        )
+        result = rpc.groupby(
+            ["slow.bcolzs"], ["g"], [["v", "sum", "v_sum"]], []
+        )
+        got = dict(zip(result["g"].tolist(), result["v_sum"].tolist()))
+        expect = df.groupby("g")["v"].sum().to_dict()
+        assert got == expect
+        # the worker survived: still registered, file still advertised
+        assert worker.worker_id in controller.worker_map
+        assert "slow.bcolzs" in controller.files_map
+    finally:
+        _stop([controller, worker], threads)
+
+
+def test_shard_retry_lands_on_replacement_worker(tmp_path, mem_store_url):
+    """A worker that dies mid-flight (work dispatched, no reply, silence)
+    gets its shard requeued after dispatch_timeout and the retry completes on
+    a replacement worker — the dispatch-tracking behaviour the reference left
+    as a TODO (reference bqueryd/controller.py:265)."""
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    df = pd.DataFrame(
+        {"g": np.arange(30) % 3, "v": np.arange(30, dtype=np.int64)}
+    )
+    ctable.fromdataframe(df, str(tmp_path / "r.bcolzs"))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=1.0,
+        dispatch_timeout=1.5,
+    )
+    worker_a = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.2,
+        poll_timeout=0.05,
+    )
+    a_got_work = threading.Event()
+
+    def crash_mid_work(msg):
+        """Simulate a hard crash: stop heartbeating, never reply."""
+        a_got_work.set()
+        worker_a.stop = lambda: None       # no StopMessage: silent death
+        worker_a._hb_stop.set()            # liveness thread dies too
+        worker_a.running = False
+        return None
+
+    worker_a.handle_work = crash_mid_work
+
+    worker_b = None
+    threads = _start(controller, worker_a)
+    try:
+        wait_until(
+            lambda: "r.bcolzs" in controller.files_map, desc="registration"
+        )
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=45, loglevel=logging.WARNING
+        )
+        result_box = {}
+
+        def ask():
+            result_box["df"] = rpc.groupby(
+                ["r.bcolzs"], ["g"], [["v", "sum", "v_sum"]], []
+            )
+
+        asker = threading.Thread(target=ask, daemon=True)
+        asker.start()
+        wait_until(a_got_work.is_set, desc="worker A received the shard")
+        # bring up the replacement holding the same shard file
+        worker_b = WorkerNode(
+            coordination_url=mem_store_url,
+            data_dir=str(tmp_path),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.2,
+            poll_timeout=0.05,
+        )
+        threads += _start(worker_b)
+        asker.join(timeout=40)
+        assert not asker.is_alive(), "query never completed after retry"
+        result = result_box["df"]
+        got = dict(zip(result["g"].tolist(), result["v_sum"].tolist()))
+        assert got == df.groupby("g")["v"].sum().to_dict()
+        # the retry really happened on B: A is gone from the worker map
+        wait_until(
+            lambda: worker_a.worker_id not in controller.worker_map,
+            desc="dead worker culled",
+        )
+        assert worker_b.worker_id in controller.worker_map
+    finally:
+        _stop([controller, worker_a, worker_b], threads)
+
+
 def test_memory_watchdog_stops_over_limit_worker(tmp_path, mem_store_url):
     """RSS above the limit (and caches shed without relief) stops the loop so
     a supervisor can restart the process (reference bqueryd/worker.py:232-241,
