@@ -104,7 +104,10 @@ def incoming_dir(worker, ticket):
 
 
 def check_downloads(worker):
-    """One poll cycle: claim and run any pending slot for this node."""
+    """One poll cycle: claim any pending slot for this node and hand it to
+    the worker's download pool.  The claim lock (TTL-bounded, so a crashed
+    downloader's work is reclaimable) stays held by the in-flight job and
+    also stops this poller re-claiming the same slot next tick."""
     keys = worker.store.keys(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + "*")
     random.shuffle(keys)
     node = worker.node_name
@@ -123,13 +126,7 @@ def check_downloads(worker):
             )
             if not lock.acquire(blocking=False):
                 continue
-            try:
-                worker.download_file(ticket, fileurl)
-            except Exception as exc:
-                worker.logger.exception("download %s failed", fileurl)
-                worker.fail_ticket(ticket, fileurl, str(exc))
-            finally:
-                lock.release()
+            worker.run_download(ticket, fileurl, lock)
 
 
 def get_backend(worker, scheme):
@@ -159,12 +156,16 @@ def download_file(worker, ticket, fileurl, max_retries=3):
         set_progress(worker.store, worker.node_name, ticket, fileurl, DONE)
         return
 
-    cancelled = CancelWatch(worker.store, worker.node_name, ticket, fileurl)
+    watch = CancelWatch(worker.store, worker.node_name, ticket, fileurl)
 
     def progress(done):
-        if cancelled.check():
+        # cancellation check on EVERY chunk, BEFORE any write: a progress
+        # hset after delete_download would resurrect the deleted slot and
+        # the cancellation would be lost forever (writes are what's
+        # rate-limited, not checks — the reverse drops cancellations)
+        if watch.cancelled():
             raise DownloadCancelled(fileurl)
-        set_progress(worker.store, worker.node_name, ticket, fileurl, done)
+        watch.maybe_write_progress(done)
 
     for attempt in range(max_retries):
         try:
@@ -200,22 +201,36 @@ class DownloadCancelled(Exception):
 
 
 class CancelWatch:
-    """Detects ticket cancellation (slot deleted client-side) without
-    hammering the store on every chunk."""
+    """Cancellation detection + rate-limited progress heartbeat for one
+    in-flight download.
+
+    ``cancelled()`` (a single hget) runs on every chunk;
+    ``maybe_write_progress`` throttles the hset to one per ``interval`` so
+    the store isn't hammered.  The check-before-write ordering matters: an
+    unconditional progress write after a client's ``delete_download`` would
+    re-create the deleted slot and lose the cancellation.  A delete landing
+    in the instant between check and write still resurrects the slot — the
+    reference's per-chunk check/write pair had the same (wider) window."""
 
     def __init__(self, store, node, ticket, fileurl, interval=2.0):
         self.store = store
+        self.node = node
+        self.ticket = ticket
+        self.fileurl = fileurl
         self.slot = f"{node}_{fileurl}"
         self.key = ticket_key(ticket)
         self.interval = interval
-        self._last = 0.0
+        self._last_write = 0.0
 
-    def check(self):
-        now = time.time()
-        if now - self._last < self.interval:
-            return False
-        self._last = now
+    def cancelled(self):
         return self.store.hget(self.key, self.slot) is None
+
+    def maybe_write_progress(self, done):
+        now = time.time()
+        if now - self._last_write < self.interval:
+            return
+        self._last_write = now
+        set_progress(self.store, self.node, self.ticket, self.fileurl, done)
 
 
 def remove_ticket(worker, ticket):

@@ -602,18 +602,47 @@ class WorkerNode(WorkerBase):
 
 class DownloaderNode(WorkerBase):
     """Ticket-driven blob downloader (reference bqueryd/worker.py:351-567).
-    Full pipeline logic in bqueryd_tpu.download (phase: distribution)."""
+    Full pipeline logic in bqueryd_tpu.download (phase: distribution).
+
+    Fetches run on a small thread pool (the reference ran 3 downloader
+    *processes* per box, reference misc/supervisor.conf) so a slow or hung
+    blob stream never blocks the event loop: ticket polling, WRM heartbeats,
+    and cancellation stay live during long downloads.  Pool threads never
+    touch the zmq socket — controller notifications go through a thread-safe
+    outbox drained by the event loop."""
 
     workertype = "download"
 
     def __init__(self, *args, **kw):
+        download_threads = kw.pop("download_threads", None)
         kw.setdefault("heartbeat_interval", DEFAULT_HEARTBEAT_INTERVAL)
         super().__init__(*args, **kw)
         self.download_interval = DOWNLOAD_DELAY
         self._last_download_check = 0.0
+        if download_threads is None:
+            download_threads = int(
+                os.environ.get("BQUERYD_TPU_DOWNLOAD_THREADS", "3")
+            )
+        self.download_threads = max(1, download_threads)
+        self._download_pool = None
+        import queue
+
+        self._outbox = queue.Queue()
+
+    @property
+    def download_pool(self):
+        if self._download_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._download_pool = ThreadPoolExecutor(
+                max_workers=self.download_threads,
+                thread_name_prefix=f"dl-{self.worker_id[:6]}",
+            )
+        return self._download_pool
 
     def heartbeat(self):
         super().heartbeat()
+        self._drain_outbox()
         now = time.time()
         if now - self._last_download_check >= self.download_interval:
             self._last_download_check = now
@@ -622,10 +651,43 @@ class DownloaderNode(WorkerBase):
             except Exception:
                 self.logger.exception("error checking downloads")
 
+    def _drain_outbox(self):
+        """Send controller notifications queued by pool threads (zmq sockets
+        are single-thread-only, so only the event loop may send)."""
+        import queue
+
+        while True:
+            try:
+                msg = self._outbox.get_nowait()
+            except queue.Empty:
+                return
+            self.send_to_all(msg)
+
+    def stop(self):
+        if self._download_pool is not None:
+            self._download_pool.shutdown(wait=False, cancel_futures=True)
+        self._drain_outbox()
+        super().stop()
+
     def check_downloads(self):
         from bqueryd_tpu.download import check_downloads
 
         check_downloads(self)
+
+    def run_download(self, ticket, fileurl, lock):
+        """Run one claimed download on the pool; the claim lock is held for
+        the download's lifetime and released by the pool thread."""
+
+        def job():
+            try:
+                self.download_file(ticket, fileurl)
+            except Exception as exc:
+                self.logger.exception("download %s failed", fileurl)
+                self.fail_ticket(ticket, fileurl, str(exc))
+            finally:
+                lock.release()
+
+        self.download_pool.submit(job)
 
     def download_file(self, ticket, fileurl):
         from bqueryd_tpu.download import download_file
@@ -641,7 +703,7 @@ class DownloaderNode(WorkerBase):
         from bqueryd_tpu.download import remove_ticket
 
         remove_ticket(self, ticket)
-        self.send_to_all(TicketDoneMessage({"ticket": ticket}))
+        self._outbox.put(TicketDoneMessage({"ticket": ticket}))
 
     def fail_ticket(self, ticket, fileurl, error):
         """Terminal download failure: poison the ticket (ERROR slot blocks
@@ -650,7 +712,7 @@ class DownloaderNode(WorkerBase):
         from bqueryd_tpu.download import fail_ticket
 
         fail_ticket(self, ticket, fileurl, error)
-        self.send_to_all(
+        self._outbox.put(
             TicketDoneMessage({"ticket": ticket, "error": str(error)})
         )
 

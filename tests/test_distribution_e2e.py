@@ -1,0 +1,213 @@
+"""End-to-end dataset distribution over a real blob backend.
+
+The reference exercised its full download path against localstack
+(reference tests/test_download.py:95-141); the in-process cluster fixture in
+tests/test_rpc_cluster.py fakes the fetch with a DummyDownloader.  These
+tests run the REAL pipeline — ``zip_to_file`` → blob ``put`` →
+``rpc.download(wait=True)`` → streamed ``download_file`` + unzip →
+movebcolz two-phase activation → the new shard answers a groupby — using
+:class:`bqueryd_tpu.blob.LocalFSBackend` as the object store, plus a
+mid-flight cancellation case and a liveness check during a slow fetch (the
+fetch runs on the downloader's thread pool, so WRM heartbeats continue).
+"""
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.conftest import wait_until
+
+
+@pytest.fixture
+def pipeline(tmp_path, mem_store_url):
+    """Controller + calc worker + REAL downloader + mover sharing one
+    serving dir, with a LocalFSBackend 'object store'."""
+    from bqueryd_tpu.blob import LocalFSBackend
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import DownloaderNode, MoveBcolzNode, WorkerNode
+
+    serving = tmp_path / "serving"
+    blob_root = tmp_path / "blobs"
+    serving.mkdir()
+    blob_root.mkdir()
+    backend = LocalFSBackend(root=str(blob_root))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.1,
+    )
+    calc = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(serving),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.2,
+        poll_timeout=0.05,
+    )
+    downloader = DownloaderNode(
+        coordination_url=mem_store_url,
+        data_dir=str(serving),
+        loglevel=logging.WARNING,
+        heartbeat_interval=0.2,
+        poll_timeout=0.05,
+    )
+    downloader.download_interval = 0.2
+    downloader.blob_backend = backend
+    mover = MoveBcolzNode(
+        coordination_url=mem_store_url,
+        data_dir=str(serving),
+        loglevel=logging.WARNING,
+        heartbeat_interval=0.2,
+        poll_timeout=0.05,
+    )
+    mover.download_interval = 0.2
+
+    nodes = (controller, calc, downloader, mover)
+    threads = [threading.Thread(target=n.go, daemon=True) for n in nodes]
+    for t in threads:
+        t.start()
+    wait_until(
+        lambda: len(controller.worker_map) >= 3, desc="nodes registered"
+    )
+    rpc = RPC(coordination_url=mem_store_url, timeout=60,
+              loglevel=logging.WARNING)
+    yield {
+        "rpc": rpc,
+        "controller": controller,
+        "calc": calc,
+        "downloader": downloader,
+        "mover": mover,
+        "serving": serving,
+        "backend": backend,
+    }
+    for n in nodes:
+        n.running = False
+    for t in threads:
+        t.join(timeout=5)
+
+
+def test_full_distribution_pipeline(pipeline, tmp_path):
+    """zip → put → download(wait=True) → real fetch/unzip → activation →
+    the freshly distributed shard answers a groupby."""
+    from bqueryd_tpu.download import METADATA_FILENAME
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.utils.net import zip_to_file
+
+    df = pd.DataFrame(
+        {
+            "g": np.arange(500, dtype=np.int64) % 7,
+            "v": np.arange(500, dtype=np.int64),
+        }
+    )
+    build = tmp_path / "build"
+    build.mkdir()
+    src_root = build / "fresh.bcolzs"
+    ctable.fromdataframe(df, str(src_root))
+    zip_path, _crc = zip_to_file(str(src_root), str(build))
+    pipeline["backend"].put("bcolz", "fresh.bcolzs.zip", zip_path)
+
+    result = pipeline["rpc"].download(
+        filenames=["fresh.bcolzs.zip"], bucket="bcolz", wait=True,
+        scheme="localfs",
+    )
+    assert result == "DONE"
+
+    # activation: shard dir swapped into serving with provenance metadata
+    activated = pipeline["serving"] / "fresh.bcolzs"
+    wait_until(activated.is_dir, desc="shard activated into serving dir")
+    assert (activated / METADATA_FILENAME).is_file()
+
+    # the calc worker's rescan picks it up and it answers queries
+    wait_until(
+        lambda: "fresh.bcolzs" in pipeline["controller"].files_map,
+        desc="new shard advertised",
+    )
+    got = pipeline["rpc"].groupby(
+        ["fresh.bcolzs"], ["g"], [["v", "sum", "v_sum"]], []
+    )
+    expect = df.groupby("g")["v"].sum().to_dict()
+    assert dict(zip(got["g"].tolist(), got["v_sum"].tolist())) == expect
+
+
+class SlowBackend:
+    """Streams a small payload in many chunks with a delay per chunk, firing
+    progress_cb between chunks so cancellation checks run."""
+
+    def __init__(self, total_chunks=40, delay=0.1):
+        self.total_chunks = total_chunks
+        self.delay = delay
+        self.started = threading.Event()
+        self.finished = threading.Event()
+
+    def fetch(self, bucket, key, dest_path, progress_cb=None):
+        self.started.set()
+        try:
+            with open(dest_path, "wb") as f:
+                for i in range(self.total_chunks):
+                    f.write(b"x" * 128)
+                    if progress_cb:
+                        progress_cb((i + 1) * 128)
+                    time.sleep(self.delay)
+        finally:
+            self.finished.set()
+
+
+def test_heartbeats_continue_during_slow_fetch(pipeline):
+    """The fetch runs on the download pool, so the downloader's liveness
+    (WRM last_seen at the controller) keeps advancing while the blob stream
+    crawls — the event-loop-blocking bug class from round 1."""
+    slow = SlowBackend(total_chunks=40, delay=0.1)  # ~4s fetch
+    pipeline["downloader"].blob_backend = slow
+    controller = pipeline["controller"]
+    downloader_id = pipeline["downloader"].worker_id
+
+    ticket = pipeline["rpc"].download(
+        filenames=["slow.bcolzs.zip"], bucket="bcolz", wait=False,
+        scheme="localfs",
+    )
+    wait_until(slow.started.is_set, desc="fetch started")
+    seen_before = controller.worker_map[downloader_id]["last_seen"]
+    time.sleep(1.0)
+    assert not slow.finished.is_set(), "fetch finished too fast to observe"
+    seen_during = controller.worker_map[downloader_id]["last_seen"]
+    assert seen_during > seen_before, (
+        "downloader stopped heartbeating while fetching"
+    )
+    # let it finish; the fake payload isn't a zip, so the slot just goes DONE
+    wait_until(slow.finished.is_set, timeout=15, desc="fetch finished")
+    pipeline["rpc"].delete_download(ticket)
+
+
+def test_midflight_cancellation_aborts_download(pipeline):
+    """delete_download mid-fetch deletes the slots; the in-flight download
+    observes the missing slot and aborts, removing its staging dir
+    (reference bqueryd/worker.py:418-428)."""
+    from bqueryd_tpu.download import incoming_dir
+
+    slow = SlowBackend(total_chunks=200, delay=0.1)  # ~20s unless cancelled
+    pipeline["downloader"].blob_backend = slow
+    rpc = pipeline["rpc"]
+
+    ticket = rpc.download(
+        filenames=["cancelme.bcolzs.zip"], bucket="bcolz", wait=False,
+        scheme="localfs",
+    )
+    wait_until(slow.started.is_set, desc="fetch started")
+    assert rpc.delete_download(ticket) is True
+    # CancelWatch polls every ~2s: the fetch must abort well before the
+    # 20s it would otherwise take
+    wait_until(slow.finished.is_set, timeout=10, desc="fetch aborted")
+    staging = incoming_dir(pipeline["downloader"], ticket)
+    wait_until(
+        lambda: not os.path.exists(staging), desc="staging cleaned up"
+    )
+    # ticket record is gone: nothing to activate
+    assert all(t != ticket for t, _ in rpc.downloads())

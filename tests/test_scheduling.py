@@ -133,6 +133,15 @@ class _Worker:
     def download_file(self, ticket, fileurl):
         raise IOError("bucket on fire")
 
+    def run_download(self, ticket, fileurl, lock):
+        """Synchronous version of DownloaderNode.run_download (no pool)."""
+        try:
+            self.download_file(ticket, fileurl)
+        except Exception as exc:
+            self.fail_ticket(ticket, fileurl, str(exc))
+        finally:
+            lock.release()
+
     def fail_ticket(self, ticket, fileurl, error):
         from bqueryd_tpu import download
 
