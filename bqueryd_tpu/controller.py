@@ -801,10 +801,16 @@ class ControllerNode:
             "timings": {},
             "created": time.time(),
         }
+        # single-shard queries produce exactly one payload with no merge
+        # downstream: workers may finalize representation-heavy aggregations
+        # (count_distinct) on device instead of shipping mergeable sets
+        sole = len(filenames) == 1 and kwargs.get("aggregate", True)
         for group in self._shard_groups(
             filenames, groupby_cols, agg_list, kwargs
         ):
             shard = CalcMessage({"payload": "groupby"})
+            if sole:
+                shard["sole_shard"] = True
             target = group if len(group) > 1 else group[0]
             shard.set_args_kwargs(
                 [target, groupby_cols, agg_list, where_terms],

@@ -24,6 +24,7 @@ Results travel as :class:`ResultPayload`:
   factorization-check early-out, reference bqueryd/worker.py:296-301).
 """
 
+import os
 import pickle
 from dataclasses import dataclass, field
 
@@ -75,6 +76,11 @@ class GroupByQuery:
     where_terms: list = field(default_factory=list)
     aggregate: bool = True
     expand_filter_column: str = None
+    #: controller-set hint: this shard's payload is the WHOLE query (single
+    #: shard fan-out), so no cross-payload merge will happen — count_distinct
+    #: may ship final per-group counts (computed by the device sort kernel)
+    #: instead of the distinct value sets an exact cross-shard union needs
+    sole_payload: bool = False
 
     def signature(self):
         """Hashable identity of the query (cache key component)."""
@@ -84,6 +90,7 @@ class GroupByQuery:
             freeze_value(self.where_terms or []),
             bool(self.aggregate),
             self.expand_filter_column,
+            bool(self.sole_payload),
         )
 
     def __post_init__(self):
@@ -110,9 +117,14 @@ class GroupByQuery:
         return [a[2] for a in self.agg_list]
 
 
-def _group_value_sets(group_codes, value_codes, value_uniques, n_groups,
-                      mask=None):
-    """object-ndarray[n_groups] of each group's sorted distinct values.
+def _group_distinct_flat(group_codes, value_codes, value_uniques, n_groups,
+                         mask=None):
+    """Per-group distinct values in FLAT form: ``(values, offsets)`` where
+    group ``g``'s distinct values are ``values[offsets[g]:offsets[g+1]]``.
+
+    The flat form (vs an object array of per-group arrays) keeps the payload
+    one contiguous array + one int64 offsets array: cheap to pickle, and the
+    cross-shard union merge stays fully vectorized (no per-group Python).
 
     Null group keys, null values (code < 0, e.g. NaN — matching pandas
     ``nunique(dropna=True)``), and masked-out rows contribute nothing."""
@@ -125,12 +137,30 @@ def _group_value_sets(group_codes, value_codes, value_uniques, n_groups,
     )
     g_of = pairs // nv
     v_of = pairs % nv
-    bounds = np.searchsorted(g_of, np.arange(n_groups + 1))
-    sets = np.empty(n_groups, dtype=object)
-    # one gather + boundary split; consumers (len / union-merge) don't need
-    # per-set value order, so no per-group sort
-    sets[:] = np.split(np.asarray(value_uniques)[v_of], bounds[1:-1])
-    return sets
+    offsets = np.searchsorted(g_of, np.arange(n_groups + 1)).astype(np.int64)
+    return np.asarray(value_uniques)[v_of], offsets
+
+
+def _segment_local_arange(counts):
+    """[0..c0), [0..c1), ... concatenated — index-within-segment helper."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    starts = np.cumsum(counts) - counts
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, counts)
+
+
+def filter_distinct_part(part, present):
+    """Row-filter a flat distinct part to the ``present`` groups."""
+    values = part["distinct_values"]
+    offsets = part["distinct_offsets"]
+    counts = np.diff(offsets)
+    sel = counts[present]
+    starts = offsets[:-1][present]
+    idx = np.repeat(starts, sel) + _segment_local_arange(sel)
+    new_offsets = np.zeros(len(sel) + 1, dtype=np.int64)
+    np.cumsum(sel, out=new_offsets[1:])
+    return {"distinct_values": values[idx], "distinct_offsets": new_offsets}
 
 
 class ResultPayload(dict):
@@ -277,7 +307,20 @@ class QueryEngine:
             for i, agg in distinct:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)
-                if op == "count_distinct":
+                if op == "count_distinct" and query.sole_payload:
+                    # single-shard query: this payload IS the final result,
+                    # so the device sort kernel's per-group counts suffice
+                    # (a device radix sort beats host np.unique at scale)
+                    vcodes, vuniques = self._key_codes(table, in_col)
+                    counts = ops.groupby_count_distinct(
+                        dense.astype(np.int32),
+                        np.asarray(vcodes),
+                        n_groups,
+                        max(len(vuniques), 1),
+                        mask_arr,
+                    )
+                    agg_parts[i] = {"distinct": np.asarray(counts)}
+                elif op == "count_distinct":
                     # ship the per-group distinct VALUE SETS, not counts:
                     # sets union exactly across shards/workers, where the
                     # reference's forced-'sum' client merge double-counts
@@ -287,11 +330,27 @@ class QueryEngine:
                     # live in incompatible code spaces and must never cross
                     # a shard boundary raw.
                     vcodes, vuniques = self._key_codes(table, in_col)
-                    agg_parts[i] = {
-                        "distinct_sets": _group_value_sets(
-                            np.asarray(dense), np.asarray(vcodes),
-                            np.asarray(vuniques), n_groups, mask_arr,
+                    values, offsets = _group_distinct_flat(
+                        np.asarray(dense), np.asarray(vcodes),
+                        np.asarray(vuniques), n_groups, mask_arr,
+                    )
+                    # exact cross-shard merge requires shipping the sets, so
+                    # payload size grows with total distinct values (worst
+                    # case ~ the whole column); a configurable cap keeps a
+                    # pathological query from exhausting worker/client memory
+                    limit = int(os.environ.get(
+                        "BQUERYD_TPU_DISTINCT_VALUES_LIMIT", 5_000_000
+                    ))
+                    if limit and len(values) > limit:
+                        raise ValueError(
+                            f"count_distinct on {in_col!r}: {len(values)} "
+                            f"(group, value) pairs exceeds the payload cap "
+                            f"{limit}; raise "
+                            f"BQUERYD_TPU_DISTINCT_VALUES_LIMIT to allow"
                         )
+                    agg_parts[i] = {
+                        "distinct_values": values,
+                        "distinct_offsets": offsets,
                     }
                 elif op == "sorted_count_distinct":
                     # run-boundary counts are inherently per-shard (the sort
@@ -319,7 +378,10 @@ class QueryEngine:
                 idx = np.asarray(codes_g, dtype=np.int64)
                 keys[col] = np.asarray(values)[idx]
             aggs = [
-                {k: v[present] for k, v in part.items()} for part in agg_parts
+                filter_distinct_part(part, present)
+                if "distinct_offsets" in part
+                else {k: v[present] for k, v in part.items()}
+                for part in agg_parts
             ]
             return ResultPayload.partials(
                 key_cols=query.groupby_cols,

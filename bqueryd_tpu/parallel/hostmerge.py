@@ -30,7 +30,8 @@ _MERGE_RULES = {
     "distinct": np.add,
     "min": np.minimum,
     "max": np.maximum,
-    "distinct_sets": "union",  # handled specially in _merge_partials
+    # ("distinct_values", "distinct_offsets") pairs — per-group distinct
+    # value sets in flat form — are handled specially in _merge_partials
 }
 
 
@@ -68,6 +69,92 @@ def _merge_rows(payloads):
     }
 
 
+def _align_groups(payloads, key_cols):
+    """Vectorized global key alignment.
+
+    Factorizes each key column over the concatenation of all payloads
+    (``np.unique`` handles ints, floats, and string/object keys alike), folds
+    the per-column codes into one composite code pairwise (re-factorizing
+    after each fold keeps codes bounded by the row count, so the mixed-radix
+    products cannot overflow int64), then renumbers the composite codes into
+    first-seen order — the same global ordering the previous per-group
+    Python-dict loop produced, at NumPy speed.
+
+    Returns ``(group_of, n_global, global_keys)`` where ``group_of[i]`` maps
+    payload *i*'s local groups to global group ids and ``global_keys`` are the
+    per-column key values of each global group.
+    """
+    lengths = [len(p["rows"]) for p in payloads]
+    offsets = np.cumsum([0] + lengths)
+    total = offsets[-1]
+
+    col_values = [   # concatenated raw key values per column
+        np.concatenate([np.asarray(p["keys"][c]) for p in payloads])
+        for c in key_cols
+    ]
+    combined = _pack_int_keys(col_values) if total else None
+    if combined is not None:
+        # all-integer keys with packable ranges: ONE unique over the packed
+        # composite instead of a sort per column
+        _uniq, combined = np.unique(combined, return_inverse=True)
+        combined = combined.astype(np.int64, copy=False)
+        n_comb = len(_uniq)
+    else:
+        for allv in col_values:
+            uniq, inv = np.unique(allv, return_inverse=True)
+            inv = inv.astype(np.int64, copy=False)
+            if combined is None:
+                combined, n_comb = inv, len(uniq)
+            else:
+                pair = combined * np.int64(len(uniq)) + inv
+                uniq_pair, combined = np.unique(pair, return_inverse=True)
+                combined = combined.astype(np.int64, copy=False)
+                n_comb = len(uniq_pair)
+        if combined is None:  # no key columns: everything is one group
+            combined, n_comb = np.zeros(total, dtype=np.int64), min(1, total)
+
+    # renumber into first-seen order (deterministic, matches dict semantics)
+    first_pos = np.full(n_comb, total, dtype=np.int64)
+    np.minimum.at(first_pos, combined, np.arange(total, dtype=np.int64))
+    seen_order = np.argsort(first_pos, kind="stable")
+    rank = np.empty(n_comb, dtype=np.int64)
+    rank[seen_order] = np.arange(n_comb, dtype=np.int64)
+    global_codes = rank[combined]
+
+    rep_rows = first_pos[seen_order]  # one representative row per global group
+    global_keys = {
+        c: col_values[ci][rep_rows] for ci, c in enumerate(key_cols)
+    }
+    group_of = [
+        global_codes[offsets[i]:offsets[i + 1]] for i in range(len(payloads))
+    ]
+    return group_of, n_comb, global_keys
+
+
+def _pack_int_keys(col_values):
+    """Mixed-radix-pack all-integer key columns into one int64 code array, or
+    None when any column is non-integer or the range product could overflow."""
+    if not col_values or not all(
+        np.issubdtype(v.dtype, np.integer) for v in col_values
+    ):
+        return None
+    mins = [int(v.min()) for v in col_values]
+    maxs = [int(v.max()) for v in col_values]
+    if any(m < -(1 << 63) or x >= (1 << 63) for m, x in zip(mins, maxs)):
+        return None  # uint64 beyond int64 range: np.unique fallback handles it
+    spans = [x - m + 1 for x, m in zip(maxs, mins)]
+    capacity = 1
+    for s in spans:
+        capacity *= s
+        if capacity >= (1 << 62):
+            return None
+    packed = np.zeros(len(col_values[0]), dtype=np.int64)
+    for v, m, s in zip(col_values, mins, spans):
+        packed *= np.int64(s)
+        packed += v.astype(np.int64) - np.int64(m)
+    return packed
+
+
 def _merge_partials(payloads):
     first = payloads[0]
     key_cols = first["key_cols"]
@@ -79,16 +166,7 @@ def _merge_partials(payloads):
     if len(payloads) == 1:
         return dict(first)
 
-    # Align groups by key tuple: global index = first-seen order.
-    index = {}
-    group_of = []  # per payload: array mapping local group -> global group
-    for p in payloads:
-        key_arrays = [np.asarray(p["keys"][c]) for c in key_cols]
-        local = np.empty(len(p["rows"]), dtype=np.int64)
-        for g, key in enumerate(zip(*key_arrays)) if key_arrays else []:
-            local[g] = index.setdefault(key, len(index))
-        group_of.append(local)
-    n_global = len(index)
+    group_of, n_global, global_keys = _align_groups(payloads, key_cols)
 
     def scatter(rule, parts, dtype):
         if rule in (np.minimum, np.maximum):
@@ -112,51 +190,84 @@ def _merge_partials(payloads):
     for ai in range(len(ops)):
         part_names = first["aggs"][ai].keys()
         merged = {}
+        if "distinct_offsets" in part_names:
+            flat_parts = [
+                (g, p["aggs"][ai]["distinct_values"],
+                 p["aggs"][ai]["distinct_offsets"])
+                for g, p in zip(group_of, payloads)
+            ]
+            values, offsets = _union_distinct_flat(flat_parts, n_global)
+            merged["distinct_values"] = values
+            merged["distinct_offsets"] = offsets
         for pname in part_names:
+            if pname in ("distinct_values", "distinct_offsets"):
+                continue
             rule = _MERGE_RULES[pname]
             parts = [
                 (g, np.asarray(p["aggs"][ai][pname]))
                 for g, p in zip(group_of, payloads)
             ]
-            if rule == "union":
-                # bucket every payload's set per global group, then ONE
-                # unique per group (incremental pairwise unions would re-sort
-                # the accumulated set payload-count times)
-                buckets = [[] for _ in range(n_global)]
-                for local_map, arr in parts:
-                    for g_local, g_global in enumerate(local_map):
-                        buckets[g_global].append(arr[g_local])
-                out = np.empty(n_global, dtype=object)
-                for g, bucket in enumerate(buckets):
-                    out[g] = (
-                        np.unique(np.concatenate(bucket))
-                        if bucket
-                        else np.empty(0)
-                    )
-                merged[pname] = out
-            else:
-                merged[pname] = scatter(rule, parts, parts[0][1].dtype)
+            merged[pname] = scatter(rule, parts, parts[0][1].dtype)
         aggs.append(merged)
 
-    # global key arrays in first-seen order
-    keys = {}
-    key_tuples = list(index.keys())
-    for ci, col in enumerate(key_cols):
-        sample = np.asarray(first["keys"][col])
-        keys[col] = np.array(
-            [t[ci] for t in key_tuples],
-            dtype=sample.dtype if sample.dtype != object else object,
-        )
     return {
         "format": first["format"],
         "kind": "partials",
         "key_cols": key_cols,
-        "keys": keys,
+        "keys": global_keys,
         "rows": rows,
         "aggs": aggs,
         "ops": ops,
         "out_cols": out_cols,
     }
+
+
+def _union_distinct_flat(parts, n_global):
+    """Union per-group distinct value sets across payloads, fully vectorized.
+
+    ``parts`` is ``[(local_map, values, offsets), ...]`` in the flat
+    per-group representation.  Expands each payload's offsets into global
+    group ids, factorizes the values once (``np.unique`` also covers string
+    values), dedupes (group, value) pairs via composite codes, and re-splits
+    into one merged flat (values, offsets) — no per-group Python loop.
+    """
+    vals_chunks, gid_chunks = [], []
+    for local_map, values, offsets in parts:
+        values = np.asarray(values)
+        if len(values) == 0:
+            continue
+        counts = np.diff(np.asarray(offsets))
+        vals_chunks.append(values)
+        gid_chunks.append(np.repeat(np.asarray(local_map), counts))
+    if not vals_chunks:
+        return np.empty(0), np.zeros(n_global + 1, dtype=np.int64)
+    all_vals = np.concatenate(vals_chunks)
+    all_gids = np.concatenate(gid_chunks)
+    span = None
+    if np.issubdtype(all_vals.dtype, np.integer):
+        vmin = int(all_vals.min())
+        vmax = int(all_vals.max())
+        span = vmax - vmin + 1
+        if n_global * span >= (1 << 62) or vmax >= (1 << 63):
+            span = None  # overflow (incl. uint64 beyond int64): unique path
+    if span is not None:
+        # integer values with a packable range: dedupe (group, value) pairs
+        # with ONE unique over packed codes, no value factorization sort
+        pair = all_gids.astype(np.int64) * np.int64(span) + (
+            all_vals.astype(np.int64) - np.int64(vmin)
+        )
+        uniq_pairs = np.unique(pair)
+        merged_vals = (uniq_pairs % span + vmin).astype(all_vals.dtype)
+        counts = np.bincount(uniq_pairs // span, minlength=n_global)
+    else:
+        uniq_vals, vinv = np.unique(all_vals, return_inverse=True)
+        pair = all_gids.astype(np.int64) * np.int64(len(uniq_vals)) + vinv
+        uniq_pairs = np.unique(pair)
+        merged_vals = uniq_vals[uniq_pairs % len(uniq_vals)]
+        counts = np.bincount(uniq_pairs // len(uniq_vals), minlength=n_global)
+    offsets = np.zeros(n_global + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return merged_vals, offsets
 
 
 def finalize_table(merged):
@@ -187,11 +298,11 @@ def finalize_table(merged):
         elif op in ("count", "count_na"):
             values = agg["count"]
         elif op == "count_distinct":
-            values = np.fromiter(
-                (len(s) for s in agg["distinct_sets"]),
-                dtype=np.int64,
-                count=len(agg["distinct_sets"]),
-            )
+            if "distinct" in agg:
+                # sole-payload result: final counts computed on device
+                values = np.asarray(agg["distinct"])
+            else:
+                values = np.diff(np.asarray(agg["distinct_offsets"]))
         elif op == "sorted_count_distinct":
             values = agg["distinct"]
         elif op in ("min", "max"):

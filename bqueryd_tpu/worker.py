@@ -532,6 +532,7 @@ class WorkerNode(WorkerBase):
             where_terms or [],
             aggregate=kwargs.get("aggregate", True),
             expand_filter_column=kwargs.get("expand_filter_column"),
+            sole_payload=bool(msg.get("sole_shard")),
         )
         filenames = filename if isinstance(filename, list) else [filename]
         tables = []

@@ -118,6 +118,49 @@ def test_count_distinct(table):
     assert_frames_match(got, expected, ["payment_type"])
 
 
+def test_count_distinct_sole_payload_device_kernel(table):
+    """sole_payload=True routes count_distinct through the device sort
+    kernel (final counts, no sets); results must match the sets path and
+    pandas nunique, including under a filter and on a string column."""
+    df, ct = table
+    for value_col, where in [
+        ("passenger_count", []),
+        ("passenger_count", [("trip_distance", ">", 4.0)]),
+        ("flag", []),
+    ]:
+        query = GroupByQuery(
+            ["payment_type"],
+            [[value_col, "count_distinct", "nuniq"]],
+            where_terms=where,
+            sole_payload=True,
+        )
+        payload = QueryEngine().execute_local(ct, query)
+        # the device path ships counts, not value sets
+        assert "distinct" in payload["aggs"][0]
+        assert "distinct_offsets" not in payload["aggs"][0]
+        got = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads([ResultPayload.from_bytes(payload.to_bytes())])
+        )
+        sub = df if not where else df[df.trip_distance > 4.0]
+        expected = (
+            sub.groupby("payment_type")[value_col].nunique()
+            .reset_index().rename(columns={value_col: "nuniq"})
+        )
+        assert_frames_match(got, expected, ["payment_type"])
+
+
+def test_distinct_values_payload_cap(table, monkeypatch):
+    """The configurable cap rejects count_distinct payloads whose (group,
+    value) pairs would exhaust memory, with an actionable error."""
+    df, ct = table
+    monkeypatch.setenv("BQUERYD_TPU_DISTINCT_VALUES_LIMIT", "3")
+    query = GroupByQuery(
+        ["payment_type"], [["passenger_count", "count_distinct", "nuniq"]]
+    )
+    with pytest.raises(ValueError, match="DISTINCT_VALUES_LIMIT"):
+        QueryEngine().execute_local(ct, query)
+
+
 def test_raw_rows_mode(table):
     df, got = run_query(
         table,

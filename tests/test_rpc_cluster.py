@@ -216,6 +216,25 @@ def test_count_distinct_sharded(cluster, taxi_df):
     pd.testing.assert_frame_equal(got, expected, check_dtype=False)
 
 
+def test_count_distinct_single_file_device_path(cluster, taxi_df):
+    """A single-file count_distinct query gets the controller's sole-shard
+    hint and finalizes on device (counts, no value sets) — same answer as
+    pandas nunique."""
+    got = cluster["rpc"].groupby(
+        ["taxi.bcolz"],
+        ["payment_type"],
+        [["passenger_count", "count_distinct", "nuniq"]],
+        [],
+    )
+    expected = (
+        taxi_df.groupby("payment_type")["passenger_count"]
+        .nunique()
+        .reset_index(name="nuniq")
+    )
+    got = got.sort_values("payment_type").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
 def test_count_distinct_string_column_across_shards(tmp_path, mem_store_url):
     """Per-shard dictionaries encode the same string with different codes;
     the distinct-set merge must union VALUES, not codes."""
