@@ -233,7 +233,7 @@ def _limb_rows(values, nbits):
 def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
                        use_pallas=False):
     """MXU path: one ``dot_general`` of stacked bf16 rows (a ones row for
-    counts, byte limbs for int sums, a hi/lo bf16 pair for float32 sums)
+    counts, byte limbs for int sums, a 3-limb bf16 split for float32 sums)
     against the blocked one-hot of the folded codes."""
     valid = codes >= 0
     if mask is not None:
@@ -288,10 +288,18 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
             else:
                 v = values.astype(jnp.float32)
                 v = jnp.where(valid & ~_null_mask(v), v, 0.0)
+                # 3-limb Dekker split: each bf16 limb captures >=8 mantissa
+                # bits and each residual is exact in f32, so hi+mid+lo
+                # reconstructs all 24 f32 mantissa bits — the measure's
+                # REPRESENTATION on the MXU path is lossless and the only
+                # error left is the accumulation rounding any f32 sum has
                 hi = v.astype(jnp.bfloat16)
-                lo = (v - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                r1 = v - hi.astype(jnp.float32)
+                mid = r1.astype(jnp.bfloat16)
+                lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
                 plans.append(
-                    ("float_sum", op, add_float(hi), add_float(lo), present_row)
+                    ("float_sum", op, add_float(hi), add_float(mid),
+                     add_float(lo), present_row)
                 )
         elif op == "count":
             plans.append(("count", op, present_row))
@@ -358,8 +366,12 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
                 partial["count"] = count.astype(jnp.int64)
             aggs.append(partial)
         elif kind == "float_sum":
-            _, _, hi_idx, lo_idx, present_row = plan
-            partial = {"sum": tot_f[f_pos[hi_idx]] + tot_f[f_pos[lo_idx]]}
+            _, _, hi_idx, mid_idx, lo_idx, present_row = plan
+            # add smallest-magnitude limbs first for accuracy
+            partial = {
+                "sum": (tot_f[f_pos[lo_idx]] + tot_f[f_pos[mid_idx]])
+                + tot_f[f_pos[hi_idx]]
+            }
             if op == "mean":
                 partial["count"] = int_row(present_row).astype(jnp.int64)
             aggs.append(partial)
