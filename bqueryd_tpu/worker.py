@@ -418,6 +418,7 @@ class WorkerNode(WorkerBase):
         self._engine = None
         self._mesh_executor = None
         self._result_cache = None
+        self._warmup_thread = None
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
         from bqueryd_tpu import ops
@@ -426,15 +427,28 @@ class WorkerNode(WorkerBase):
 
     def go(self):
         if os.environ.get("BQUERYD_TPU_WARMUP", "1") == "1":
-            self.warmup()
+            self._warmup_thread = threading.Thread(
+                target=self.warmup,
+                name=f"warmup-{self.worker_id[:6]}",
+                daemon=True,
+            )
+            self._warmup_thread.start()
         super().go()
 
     def warmup(self):
         """Prime the JAX backend (PJRT client init + a tiny kernel compile)
-        before serving, so the first real query's dispatch window pays only
-        its own shape's compile, not device bring-up.  Runs before the first
-        WRM broadcast: the worker is not advertised until it is ready."""
+        in the BACKGROUND so the worker advertises its shards immediately.
+
+        Backend bring-up on a tunneled TPU can take many minutes; gating the
+        first WRM broadcast on it made every worker restart a registration
+        blackout (the round-2 benchmark failure).  Instead the worker is
+        queryable at once — a query arriving mid-warmup simply blocks on the
+        same JAX backend-init lock, and the liveness heartbeat thread plus
+        the controller's inflight-aware cull keep the busy worker alive for
+        however long that takes (reference bqueryd/worker.py:107-143 was
+        queryable ~20s after start; this restores that property on TPU)."""
         t0 = time.time()
+        self.logger.info("starting JAX backend warmup in background")
         try:
             import numpy as np
 
