@@ -70,7 +70,14 @@ class S3Backend(BlobBackend):
 
     scheme = "s3"
 
-    def __init__(self, endpoint_url=None, access_key=None, secret_key=None):
+    def __init__(
+        self, endpoint_url=None, access_key=None, secret_key=None, client=None
+    ):
+        if client is not None:
+            # injection seam: tests (and exotic deployments) hand in a
+            # ready-made client; boto3 never has to be importable
+            self.client = client
+            return
         import boto3  # gated import: optional dependency
 
         kwargs = {}
@@ -107,7 +114,10 @@ class AzureBackend(BlobBackend):
 
     scheme = "azure"
 
-    def __init__(self, conn_string=None):
+    def __init__(self, conn_string=None, service=None):
+        if service is not None:
+            self.service = service  # injection seam, as S3Backend.client
+            return
         from azure.storage.blob import BlobServiceClient  # gated import
 
         conn = conn_string or os.environ.get("AZURE_STORAGE_CONNECTION_STRING")

@@ -103,6 +103,7 @@ class ControllerNode:
 
         # state
         self.worker_map = {}          # worker_id -> wrm info (+ last_seen/busy)
+        self._adoption_blocked = {}   # worker_id -> until-ts (hb-only quarantine)
         self.files_map = {}           # filename -> set(worker_id)
         self.others = {}              # peer address -> info
         self.worker_out_messages = {None: []}  # affinity -> [msg, ...]
@@ -216,6 +217,25 @@ class ControllerNode:
         requeued, and with nothing in flight the cull proceeds next tick."""
         now = time.time()
         for worker_id, info in list(self.worker_map.items()):
+            # hb_only adoptees heartbeat forever even with a permanently
+            # wedged main loop — and while advertised-but-busy they block the
+            # 'no longer on any worker' fail-fast for their shards without
+            # ever going inflight (so no dispatch timeout fires either).
+            # Give the main socket dispatch_hard_timeout to speak (a legit
+            # first-query compile fits), then reclaim.
+            hb_since = info.get("hb_only")
+            if hb_since and now - hb_since > self.dispatch_hard_timeout:
+                self.logger.warning(
+                    "hb-only worker %s never spoke on its main socket in "
+                    "%.0fs, removing", worker_id, now - hb_since,
+                )
+                # quarantine against instant re-adoption by its (still
+                # ticking) heartbeat thread; a real main-socket WRM lifts it
+                self._adoption_blocked[worker_id] = (
+                    now + self.dispatch_hard_timeout
+                )
+                self.remove_worker(worker_id)
+                continue
             if now - info.get("last_seen", now) <= self.dead_worker_timeout:
                 continue
             if any(
@@ -341,8 +361,20 @@ class ControllerNode:
         except zmq.ZMQError as exc:
             self.logger.warning("send to worker %s failed: %s", worker_id, exc)
             self.remove_worker(worker_id)
-            self._requeue({"msg": msg, "retries": msg.get("_retries", 0),
-                           "parent": msg.get("parent_token")})
+            # a missing route (EHOSTUNREACH) is a controller-side routing
+            # fact, not evidence against the shard: requeue without charging
+            # the retry budget.  Progress is still guaranteed — the worker
+            # was just removed, so the shard either reschedules onto another
+            # holder or fails fast via 'no longer on any worker'.  Any OTHER
+            # send failure (e.g. EAGAIN on a congested pipe under SNDTIMEO)
+            # still charges, or a live-but-wedged worker that keeps
+            # re-registering would loop the dispatch forever.
+            unroutable = getattr(exc, "errno", None) == zmq.EHOSTUNREACH
+            self._requeue(
+                {"msg": msg, "retries": msg.get("_retries", 0),
+                 "parent": msg.get("parent_token")},
+                charge_retry=not unroutable,
+            )
             return
         if worker_id in self.worker_map:
             self.worker_map[worker_id]["busy"] = True
@@ -398,18 +430,18 @@ class ControllerNode:
                 )
                 self.remove_worker(entry["worker"])
 
-    def _requeue(self, entry):
+    def _requeue(self, entry, charge_retry=True):
         msg = entry["msg"]
         retries = entry.get("retries", 0)
         parent = entry.get("parent") or msg.get("parent_token")
-        if retries >= MAX_DISPATCH_RETRIES:
+        if charge_retry and retries >= MAX_DISPATCH_RETRIES:
             self.abort_parent(
                 parent,
                 f"shard {msg.get('filename')} failed after "
                 f"{retries} retries (worker lost or timed out)",
             )
             return
-        msg["_retries"] = retries + 1
+        msg["_retries"] = retries + 1 if charge_retry else retries
         affinity = msg.get("affinity")
         self.worker_out_messages.setdefault(affinity, []).append(msg)
 
@@ -469,17 +501,36 @@ class ControllerNode:
                 known = self.worker_map.get(worker_id)
                 if known is not None:
                     known["last_seen"] = now
+                elif self._adoption_blocked.get(worker_id, 0) > now:
+                    # quarantined: this worker was hard-culled as an hb_only
+                    # adoptee whose main loop never spoke — its heartbeat
+                    # thread is still ticking, and re-adopting it would
+                    # repopulate files_map and make every new query wait out
+                    # another full hard-timeout window
+                    return
                 else:
+                    # adopt as BUSY + hb_only: the worker's main loop is deep
+                    # in a long handle_work and the ROUTER may only hold a
+                    # route for the '.hb' identity — dispatching now would
+                    # EHOSTUNREACH, remove the worker, and burn the shard's
+                    # retry budget in a re-adopt loop.  The first message on
+                    # the main socket (WRM/Done/result) proves the real route
+                    # and clears both flags.
                     info = dict(msg)
                     info["last_seen"] = now
-                    info["busy"] = False
+                    info["busy"] = True
+                    info["hb_only"] = now  # adoption time: expiry-checked in cull
                     self.worker_map[worker_id] = info
                     for filename in info.get("data_files") or []:
                         self.files_map.setdefault(filename, set()).add(worker_id)
                 return
+            prev = self.worker_map.get(worker_id, {})
+            self._adoption_blocked.pop(worker_id, None)  # main loop is back
             info = dict(msg)
             info["last_seen"] = now
-            info["busy"] = self.worker_map.get(worker_id, {}).get("busy", False)
+            # an hb_only adoption's busy=True was a placeholder, not observed
+            # state — a main-socket WRM proves the route and resets it
+            info["busy"] = False if prev.get("hb_only") else prev.get("busy", False)
             self.worker_map[worker_id] = info
             current_files = set(info.get("data_files", []))
             for filename in current_files:
@@ -499,6 +550,9 @@ class ControllerNode:
             }
         else:
             self.worker_map[worker_id]["last_seen"] = now
+            # any main-socket message proves the real route exists
+            self.worker_map[worker_id].pop("hb_only", None)
+            self._adoption_blocked.pop(worker_id, None)
 
         if msg.isa(BusyMessage):
             self.worker_map[worker_id]["busy"] = True

@@ -52,6 +52,15 @@ class StoreLock:
     def release(self):
         self.store._lock_release(self.name, self.token)
 
+    def extend(self, additional_time):
+        """Push the expiry ``additional_time`` seconds past now; long-running
+        holders (a blob fetch outlasting the claim TTL) call this from their
+        progress path so the claim can't expire mid-download and be re-claimed
+        into a duplicate concurrent fetch."""
+        return self.store._lock_acquire(
+            self.name, self.token, additional_time
+        )
+
     def __enter__(self):
         self.acquire()
         return self
@@ -398,7 +407,13 @@ class RedisStore(CoordinationStore):
         self._r.flushdb()
 
     def lock(self, name, ttl):
-        return _RedisLockAdapter(self._r.lock(name, timeout=ttl))
+        # thread_local=False: the claim is acquired on the event-loop thread
+        # but released (and extended) by the download-pool thread; redis-py's
+        # default thread-local token would make that cross-thread release
+        # silently fail and pin the lock for its full TTL
+        return _RedisLockAdapter(
+            self._r.lock(name, timeout=ttl, thread_local=False)
+        )
 
 
 class _RedisLockAdapter:
@@ -422,6 +437,16 @@ class _RedisLockAdapter:
             self._lock.release()
         except redis.exceptions.LockError:
             pass
+
+    def extend(self, additional_time):
+        import redis.exceptions
+
+        try:
+            # replace_ttl: expiry becomes now+additional_time (StoreLock
+            # semantics), not a cumulative add
+            return self._lock.extend(additional_time, replace_ttl=True)
+        except redis.exceptions.LockError:
+            return False
 
     def __enter__(self):
         self.acquire()

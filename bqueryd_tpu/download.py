@@ -139,11 +139,13 @@ def get_backend(worker, scheme):
     return blob_mod.backend_for(scheme)
 
 
-def download_file(worker, ticket, fileurl, max_retries=3):
+def download_file(worker, ticket, fileurl, max_retries=3, lock=None):
     """Stream one blob into incoming/<ticket>/<filename>; zip archives are
     extracted in place (shards travel zipped, reference bqueryd/worker.py:453,
     500-505).  Mid-flight cancellation: if the ticket's slot disappears, the
-    download aborts and cleans up."""
+    download aborts and cleans up.  ``lock`` is the claim lock, periodically
+    extended from the progress path so a fetch outlasting the TTL can't be
+    re-claimed into a duplicate concurrent download."""
     scheme, bucket, key = blob_mod.parse_url(fileurl)
     backend = get_backend(worker, scheme)
     dest_dir = incoming_dir(worker, ticket)
@@ -156,7 +158,10 @@ def download_file(worker, ticket, fileurl, max_retries=3):
         set_progress(worker.store, worker.node_name, ticket, fileurl, DONE)
         return
 
-    watch = CancelWatch(worker.store, worker.node_name, ticket, fileurl)
+    watch = CancelWatch(
+        worker.store, worker.node_name, ticket, fileurl,
+        lock=lock, lock_ttl=bqueryd_tpu.REDIS_DOWNLOAD_LOCK_DURATION,
+    )
 
     def progress(done):
         # cancellation check on EVERY chunk, BEFORE any write: a progress
@@ -210,9 +215,17 @@ class CancelWatch:
     unconditional progress write after a client's ``delete_download`` would
     re-create the deleted slot and lose the cancellation.  A delete landing
     in the instant between check and write still resurrects the slot — the
-    reference's per-chunk check/write pair had the same (wider) window."""
+    reference's per-chunk check/write pair had the same (wider) window.
 
-    def __init__(self, store, node, ticket, fileurl, interval=2.0):
+    When a claim ``lock`` is supplied its TTL is re-armed from the same
+    throttled path (every ``lock_ttl/3`` seconds), so a fetch that outlasts
+    the TTL keeps its claim instead of letting another poll cycle start a
+    duplicate concurrent download to the same dest file."""
+
+    def __init__(
+        self, store, node, ticket, fileurl, interval=2.0,
+        lock=None, lock_ttl=None,
+    ):
         self.store = store
         self.node = node
         self.ticket = ticket
@@ -220,7 +233,10 @@ class CancelWatch:
         self.slot = f"{node}_{fileurl}"
         self.key = ticket_key(ticket)
         self.interval = interval
+        self.lock = lock if lock is not None and lock_ttl else None
+        self.lock_ttl = lock_ttl
         self._last_write = 0.0
+        self._last_extend = time.time()
 
     def cancelled(self):
         return self.store.hget(self.key, self.slot) is None
@@ -231,6 +247,12 @@ class CancelWatch:
             return
         self._last_write = now
         set_progress(self.store, self.node, self.ticket, self.fileurl, done)
+        if self.lock is not None and now - self._last_extend >= self.lock_ttl / 3:
+            self._last_extend = now
+            try:
+                self.lock.extend(self.lock_ttl)
+            except Exception:
+                pass  # best-effort: an expired claim is the pre-existing risk
 
 
 def remove_ticket(worker, ticket):

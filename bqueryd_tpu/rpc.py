@@ -195,20 +195,39 @@ class RPC:
         return stacked.groupby(key_cols, sort=True).sum().reset_index()
 
     # -- download helpers (client-local, straight to the store) ------------
+    def get_download_data(self):
+        """Raw ticket hashes keyed by their full store key — the reference's
+        exact shape (reference bqueryd/rpc.py:181-188), for tooling written
+        against it."""
+        data = {}
+        for key in self.store.keys(bqueryd_tpu.REDIS_TICKET_KEY_PREFIX + "*"):
+            data[key] = self.store.hgetall(key)
+        return data
+
     def downloads(self):
-        """Progress of in-flight download tickets, read client-side from the
-        coordination store (reference bqueryd/rpc.py:181-199)."""
+        """Summaries of in-flight download tickets as ``(ticket,
+        "done/total")`` tuples — the reference's output shape (reference
+        bqueryd/rpc.py:190-199).  Per-slot detail: ``download_progress()``."""
         out = []
         prefix = bqueryd_tpu.REDIS_TICKET_KEY_PREFIX
-        for key in self.store.keys(prefix + "*"):
-            ticket = key[len(prefix):]
-            entries = self.store.hgetall(key)
+        for key, entries in self.get_download_data().items():
+            done = sum(1 for v in entries.values() if v.endswith("_DONE"))
+            out.append((key[len(prefix):], f"{done}/{len(entries)}"))
+        return out
+
+    def download_progress(self):
+        """Per-slot download states: ``[(ticket, {(node, fileurl): state})]``
+        — richer than the reference's done/total summary; ERROR states are
+        visible here."""
+        out = []
+        prefix = bqueryd_tpu.REDIS_TICKET_KEY_PREFIX
+        for key, entries in self.get_download_data().items():
             progress = {}
             for slot, value in entries.items():
                 node, _, fileurl = slot.partition("_")
-                timestamp, _, state = value.rpartition("_")
+                _, _, state = value.rpartition("_")
                 progress[(node, fileurl)] = state
-            out.append((ticket, progress))
+            out.append((key[len(prefix):], progress))
         return out
 
     def delete_download(self, ticket):

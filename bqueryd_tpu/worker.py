@@ -169,19 +169,38 @@ class WorkerBase:
         self._hb_thread.start()
 
     def _heartbeat_loop(self):
-        sock = self.context.socket(zmq.DEALER)
-        # distinct identity: this socket must never be addressed as the worker
-        sock.identity = (self.worker_id + ".hb").encode()
-        sock.setsockopt(zmq.LINGER, 0)
-        connected = set()
+        # ONE DEALER per controller address: a DEALER with several connected
+        # peers round-robins sends across their pipes, so per-controller
+        # delivery each tick would be probabilistic (a dead peer's pipe
+        # absorbs copies while another gets duplicates).  A socket that
+        # connects to exactly one endpoint makes every tick's delivery
+        # addressed, whatever the controller count.
+        socks = {}  # controller address -> DEALER connected only to it
         try:
             while not self._hb_stop.is_set() and self.running:
                 try:
-                    self._sync_controller_connections(sock, connected)
+                    current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
+                    for addr in current - socks.keys():
+                        sock = self.context.socket(zmq.DEALER)
+                        # distinct identity: this socket must never be
+                        # addressed as the worker
+                        sock.identity = (self.worker_id + ".hb").encode()
+                        sock.setsockopt(zmq.LINGER, 0)
+                        try:
+                            sock.connect(addr)
+                        except zmq.ZMQError:
+                            # one bad membership entry must not leak a socket
+                            # per tick nor abort this tick's broadcast to the
+                            # healthy controllers
+                            sock.close()
+                            continue
+                        socks[addr] = sock
+                    for addr in socks.keys() - current:
+                        socks.pop(addr).close()
                     wrm = self.prepare_wrm()
                     wrm["liveness_only"] = True  # files rescanned on main loop
                     payload = wrm.to_json().encode()
-                    for addr in connected:
+                    for sock in socks.values():
                         try:
                             sock.send_multipart([payload], zmq.NOBLOCK)
                         except zmq.ZMQError:
@@ -191,13 +210,15 @@ class WorkerBase:
                 # re-broadcast well inside the controller's dead timeout
                 self._hb_stop.wait(min(self.heartbeat_interval, 10.0))
         finally:
-            sock.close()
+            for sock in socks.values():
+                sock.close()
 
     # -- discovery / registration -----------------------------------------
     def _sync_controller_connections(self, sock, connected):
-        """Reconcile ``sock``'s connections with the membership set; used by
-        both the main ROUTER socket and the liveness thread's DEALER socket
-        (each thread owns its socket + tracking set exclusively)."""
+        """Reconcile the main ROUTER socket's connections with the membership
+        set.  (The liveness thread manages its own per-controller DEALER
+        sockets inline in ``_heartbeat_loop`` — one socket per address, so
+        heartbeat delivery is addressed rather than round-robined.)"""
         current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
         for addr in current - connected:
             self.logger.debug("connecting to controller %s", addr)
@@ -695,7 +716,7 @@ class DownloaderNode(WorkerBase):
 
         def job():
             try:
-                self.download_file(ticket, fileurl)
+                self.download_file(ticket, fileurl, lock=lock)
             except Exception as exc:
                 self.logger.exception("download %s failed", fileurl)
                 self.fail_ticket(ticket, fileurl, str(exc))
@@ -704,10 +725,10 @@ class DownloaderNode(WorkerBase):
 
         self.download_pool.submit(job)
 
-    def download_file(self, ticket, fileurl):
+    def download_file(self, ticket, fileurl, lock=None):
         from bqueryd_tpu.download import download_file
 
-        download_file(self, ticket, fileurl)
+        download_file(self, ticket, fileurl, lock=lock)
 
     def file_downloader_progress(self, ticket, fileurl, progress):
         from bqueryd_tpu.download import set_progress

@@ -425,3 +425,202 @@ def test_memory_watchdog_shed_recovery_keeps_running(
     worker._check_mem()
     assert worker.running is True
     worker.socket.close()
+
+
+def test_two_controllers_both_get_heartbeats_during_long_work(
+    tmp_path, mem_store_url
+):
+    """Per-controller ADDRESSED heartbeat delivery: with two controllers and
+    the worker's event loop blocked in a long handle_work, BOTH controllers'
+    last_seen must keep refreshing (a single shared DEALER round-robins its
+    sends across peers, making per-controller delivery probabilistic)."""
+    import time as time_mod
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import WorkerNode
+
+    a = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path / "a"),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=10.0,
+    )
+    b = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path / "b"),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=10.0,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    threads = _start(a, b, worker)
+    try:
+        wid = worker.worker_id
+        wait_until(
+            lambda: wid in a.worker_map and wid in b.worker_map,
+            desc="worker registered on both controllers",
+        )
+        rpc = RPC(
+            coordination_url=mem_store_url,
+            address=a.address,
+            timeout=30,
+            loglevel=logging.WARNING,
+        )
+        done = threading.Event()
+
+        def ask():
+            rpc.sleep(2.0)
+            done.set()
+
+        threading.Thread(target=ask, daemon=True).start()
+        wait_until(
+            lambda: a.worker_map.get(wid, {}).get("busy"),
+            desc="worker busy in long work",
+        )
+        # while the event loop is blocked, sample last_seen on BOTH
+        seen_a0 = a.worker_map[wid]["last_seen"]
+        seen_b0 = b.worker_map[wid]["last_seen"]
+        time_mod.sleep(0.6)  # several heartbeat ticks
+        assert not done.is_set(), "work finished too early to measure"
+        assert a.worker_map[wid]["last_seen"] > seen_a0
+        assert b.worker_map[wid]["last_seen"] > seen_b0
+        wait_until(done.is_set, desc="sleep verb completed")
+    finally:
+        _stop([a, b, worker], threads)
+
+
+def test_hb_only_adoption_is_busy_until_main_socket_speaks(mem_store_url):
+    """A worker adopted from a liveness-only heartbeat (controller restarted
+    while the worker is deep in handle_work) must not be dispatchable: the
+    ROUTER may only hold a route for the '.hb' identity, and dispatching
+    would EHOSTUNREACH -> remove -> re-adopt in a loop that burns the
+    shard's retry budget.  The first main-socket WRM clears the flag."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import WorkerRegisterMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        wrm = WorkerRegisterMessage(
+            {
+                "worker_id": "w1",
+                "workertype": "calc",
+                "data_files": ["s.bcolzs"],
+                "liveness_only": True,
+            }
+        )
+        controller.handle_worker(b"w1.hb", wrm)
+        info = controller.worker_map["w1"]
+        assert info["busy"] is True and info.get("hb_only")
+        assert "s.bcolzs" in controller.files_map
+        # not dispatchable while hb_only
+        assert controller.find_free_worker(filename="s.bcolzs") is None
+
+        # main-socket WRM proves the route: busy resets, flag clears
+        full = WorkerRegisterMessage(
+            {
+                "worker_id": "w1",
+                "workertype": "calc",
+                "data_files": ["s.bcolzs"],
+            }
+        )
+        controller.handle_worker(b"w1", full)
+        info = controller.worker_map["w1"]
+        assert info["busy"] is False and not info.get("hb_only")
+        assert controller.find_free_worker(filename="s.bcolzs") == "w1"
+    finally:
+        controller.socket.close()
+
+
+def test_unroutable_dispatch_does_not_charge_retry_budget(mem_store_url):
+    """An EHOSTUNREACH send (missing ROUTER route) requeues the shard WITHOUT
+    incrementing _retries: routing facts are not evidence against the shard,
+    and charging them aborts the query after MAX_DISPATCH_RETRIES re-adopts."""
+    from bqueryd_tpu.controller import MAX_DISPATCH_RETRIES, ControllerNode
+    from bqueryd_tpu.messages import CalcMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        msg = CalcMessage(
+            {
+                "payload": "groupby",
+                "token": "t1",
+                "parent_token": "p1",
+                "filename": "s.bcolzs",
+                "_retries": MAX_DISPATCH_RETRIES,  # budget already exhausted
+            }
+        )
+        # no such route on the ROUTER -> ZMQError (ROUTER_MANDATORY) path
+        controller._send_to_worker("no-such-worker", msg)
+        queue = controller.worker_out_messages.get(None, [])
+        assert [m.get("token") for m in queue] == ["t1"], (
+            "shard must be requeued, not aborted"
+        )
+        assert queue[0].get("_retries") == MAX_DISPATCH_RETRIES
+    finally:
+        controller.socket.close()
+
+
+def test_hb_only_adoption_expires_after_hard_timeout(mem_store_url):
+    """A worker whose main loop is permanently wedged but whose heartbeat
+    thread stays alive must not block its shards forever: the adoption
+    expires after dispatch_hard_timeout and the worker is reclaimed, letting
+    queries fail fast instead of hanging to the client timeout."""
+    import time as time_mod
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import WorkerRegisterMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent", dispatch_hard_timeout=0.2,
+        dispatch_timeout=0.1,
+    )
+    try:
+        wrm = WorkerRegisterMessage(
+            {
+                "worker_id": "w1",
+                "workertype": "calc",
+                "data_files": ["s.bcolzs"],
+                "liveness_only": True,
+            }
+        )
+        controller.handle_worker(b"w1.hb", wrm)
+        assert "w1" in controller.worker_map
+        time_mod.sleep(0.25)
+        # heartbeats keep arriving (last_seen fresh) but main loop is silent
+        controller.handle_worker(b"w1.hb", wrm.copy())
+        controller.free_dead_workers()
+        assert "w1" not in controller.worker_map
+        assert "s.bcolzs" not in controller.files_map
+        # the still-ticking heartbeat thread must NOT re-adopt it (quarantine)
+        controller.handle_worker(b"w1.hb", wrm.copy())
+        assert "w1" not in controller.worker_map
+        # ...until the main socket proves the loop recovered
+        full = WorkerRegisterMessage(
+            {
+                "worker_id": "w1",
+                "workertype": "calc",
+                "data_files": ["s.bcolzs"],
+            }
+        )
+        controller.handle_worker(b"w1", full)
+        assert "w1" in controller.worker_map
+        controller.handle_worker(b"w1.hb", wrm.copy())  # liveness works again
+        assert controller.worker_map["w1"]["last_seen"]
+    finally:
+        controller.socket.close()

@@ -100,3 +100,47 @@ def test_concurrent_lock_single_winner(store):
 def test_bad_url_rejected():
     with pytest.raises(ValueError):
         coordination_store("carrier-pigeon://coop")
+
+
+def test_lock_extend_keeps_claim_past_original_ttl(store):
+    """A long-running holder (blob fetch outlasting the claim TTL) extends
+    its lock from the progress path; the claim must stay exclusive past the
+    original expiry so no duplicate concurrent download starts."""
+    import time as time_mod
+
+    l1 = store.lock("dl_lock", ttl=0.1)
+    assert l1.acquire(blocking=False)
+    assert l1.extend(30)
+    time_mod.sleep(0.15)  # past the ORIGINAL ttl
+    l2 = store.lock("dl_lock", ttl=60)
+    assert not l2.acquire(blocking=False), "extended claim must hold"
+    l1.release()
+    assert l2.acquire(blocking=False)
+    l2.release()
+
+
+def test_cancel_watch_extends_lock_on_progress(store):
+    """CancelWatch re-arms the claim lock from its throttled progress path
+    once lock_ttl/3 has elapsed."""
+    from bqueryd_tpu.download import CancelWatch, set_progress
+
+    set_progress(store, "n1", "tick1", "s3://b/f", -1)
+
+    class SpyLock:
+        def __init__(self):
+            self.extended = []
+
+        def extend(self, ttl):
+            self.extended.append(ttl)
+            return True
+
+    lock = SpyLock()
+    watch = CancelWatch(
+        store, "n1", "tick1", "s3://b/f", interval=0.0, lock=lock, lock_ttl=0.3
+    )
+    watch._last_extend -= 0.2  # cross the lock_ttl/3 threshold
+    watch.maybe_write_progress(1024)
+    assert lock.extended == [0.3]
+    # inside the threshold: no second extend
+    watch.maybe_write_progress(2048)
+    assert lock.extended == [0.3]
