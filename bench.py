@@ -29,12 +29,22 @@ client-side (reference bqueryd/rpc.py:150-173).
 Correctness gates: integer aggregates must match the baseline bit-for-bit;
 float means within 1e-6 relative.
 
-Prints ONE JSON line: {"metric", "value" (rows/s through the framework on
-the headline), "unit", "vs_baseline", "detail"}.
+Prints ONE compact JSON line LAST on stdout: {"metric", "value" (rows/s
+through the framework on the headline), "unit", "vs_baseline", "detail"}
+— kept under ~1.5 KB so log tails record it intact.  The full per-config
+breakdown (phase timings from the min-wall repeat, cold-path walls, the
+device round-trip floor) is written to BENCH_DETAIL.json next to this file.
+
+Timing discipline: each config runs one warmup query, then BENCH_REPEATS
+timed repeats; the reported wall is the min and the published phase timings
+come from THAT repeat (not the last).  A separate cold run clears the
+worker's data caches (alignment + HBM blocks + storage decode cache) first,
+so decode/factorize/H2D appear in a recorded number; compiled XLA programs
+stay cached — cold means cold data, not cold compiler.
 
 Env knobs: BENCH_ROWS (default 10_000_000), BENCH_SHARDS (10),
 BENCH_REPEATS (3), BENCH_DATA_DIR (default /tmp/bqueryd_tpu_bench),
-BENCH_CONFIGS (comma list, default all).
+BENCH_CONFIGS (comma list, default all), BENCH_COLD (default 1).
 """
 
 import io
@@ -336,14 +346,54 @@ def check_result(result_df, base_df, groupby_cols, agg_list, config):
             assert ok, f"{config}: float mismatch in {out_col}"
 
 
+def _phase_total(timings):
+    """Sum of the worker's per-phase totals across shard-group entries."""
+    if not timings:
+        return None
+    total = 0.0
+    for entry in timings.values():
+        if isinstance(entry, dict):
+            total += float(entry.get("total", 0.0))
+    return round(total, 4)
+
+
+def device_roundtrip_floor():
+    """The per-dispatch latency floor of this backend: wall of a trivial
+    jitted kernel dispatch + fetch (one submit + one result round-trip).
+    On a tunneled/remote TPU this is tens of ms of pure transport and bounds
+    every per-query wall from below — recorded so small-config speedups can
+    be attributed (round-3 verdict: the ~65 ms fixed cost was unexplained)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(f(jnp.zeros(())))
+    walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(jnp.zeros(())))
+        walls.append(time.perf_counter() - t0)
+    return min(walls)
+
+
+def _clear_worker_caches(worker):
+    """Cold-path reset: drop the worker's data caches (storage decode,
+    alignment, HBM blocks, serialized results).  Compiled XLA programs stay —
+    cold means cold data, not a recompile."""
+    worker._shed_caches()
+
+
 def main():
     t_start = time.time()
     names = build_dataset()
     rpc, nodes, threads = start_cluster()
+    worker = nodes[1]
     results = {}
+    cold_enabled = os.environ.get("BENCH_COLD", "1") == "1"
     try:
         import jax
 
+        floor_s = None
         for config in CONFIGS:
             files, gcols, aggs, where = config_query(config, names)
             nrows = ROWS * len(files) // SHARDS
@@ -358,12 +408,33 @@ def main():
                 file=sys.stderr,
                 flush=True,
             )
-            walls = []
+            if floor_s is None:
+                # measured after the first warmup so backend bring-up is done
+                floor_s = device_roundtrip_floor()
+                print(
+                    f"[bench] device dispatch+fetch floor: {floor_s*1e3:.1f} ms",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            repeats = []  # (wall, phase timings of THAT repeat)
             for _ in range(REPEATS):
                 t0 = time.perf_counter()
                 result = rpc.groupby(files, gcols, aggs, where)
-                walls.append(time.perf_counter() - t0)
-            our_wall = min(walls)
+                repeats.append(
+                    (
+                        time.perf_counter() - t0,
+                        getattr(rpc, "last_call_timings", None),
+                    )
+                )
+            our_wall, our_timings = min(repeats, key=lambda r: r[0])
+
+            cold_wall = cold_timings = None
+            if cold_enabled:
+                _clear_worker_caches(worker)
+                t0 = time.perf_counter()
+                rpc.groupby(files, gcols, aggs, where)
+                cold_wall = time.perf_counter() - t0
+                cold_timings = getattr(rpc, "last_call_timings", None)
 
             # symmetric measurement: one warmup (page cache) + REPEATS timed
             # for the baseline, same as the framework side
@@ -376,23 +447,43 @@ def main():
                 base_walls.append(wall)
             base_wall = min(base_walls)
             check_result(result, base_df, gcols, aggs, config)
+            worker_total = _phase_total(our_timings)
             results[config] = {
                 "rows": nrows,
                 "groups": len(base_df),
                 "framework_wall_s": round(our_wall, 4),
                 "warmup_wall_s": round(warm_s, 2),
+                "cold_wall_s": (
+                    None if cold_wall is None else round(cold_wall, 4)
+                ),
                 "reference_shaped_wall_s": round(base_wall, 4),
                 "rows_per_sec": round(nrows / our_wall, 1),
                 "speedup": round(base_wall / our_wall, 3),
                 # per-phase breakdown (open/decode/H2D/kernel/collect/...)
-                # measured on the worker for the last timed repeat
-                # (worker.py handle_work -> controller -> rpc.last_call_timings)
-                "phase_timings": getattr(rpc, "last_call_timings", None),
+                # measured on the worker, from the SAME repeat as the
+                # reported min wall (round-3 verdict: last-repeat timings
+                # against min-repeat walls made the data self-contradictory)
+                "phase_timings": our_timings,
+                "cold_phase_timings": cold_timings,
+                # client wall minus worker phase total = zmq + controller +
+                # pickle overhead; compare with device_roundtrip_floor_s
+                "worker_phase_total_s": worker_total,
+                "dispatch_gap_s": (
+                    None
+                    if worker_total is None
+                    else round(our_wall - worker_total, 4)
+                ),
             }
             print(
                 f"[bench] {config}: {nrows / our_wall:,.0f} rows/s "
                 f"(framework {our_wall:.3f}s vs baseline {base_wall:.3f}s, "
-                f"speedup {base_wall / our_wall:.2f}x)",
+                f"speedup {base_wall / our_wall:.2f}x"
+                + (
+                    f", cold {cold_wall:.3f}s"
+                    if cold_wall is not None
+                    else ""
+                )
+                + ")",
                 file=sys.stderr,
                 flush=True,
             )
@@ -404,6 +495,35 @@ def main():
             if head_name == HEADLINE
             else f"taxi_groupby_{head_name}_e2e_rows_per_sec"
         )
+        detail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+        )
+        full_detail = {
+            "rows": ROWS,
+            "shards": SHARDS,
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+            "device_roundtrip_floor_s": (
+                None if floor_s is None else round(floor_s, 4)
+            ),
+            "configs": results,
+            "total_s": round(time.time() - t_start, 1),
+        }
+        with open(detail_path, "w") as f:
+            json.dump(full_detail, f, indent=1)
+        print(f"[bench] full detail -> {detail_path}", file=sys.stderr,
+              flush=True)
+        # the ONE machine-read line: compact (no phase timings — those live
+        # in BENCH_DETAIL.json), backend/n_devices up front, printed LAST
+        compact_configs = {
+            name: {
+                "wall_s": r["framework_wall_s"],
+                "cold_s": r["cold_wall_s"],
+                "base_s": r["reference_shaped_wall_s"],
+                "speedup": r["speedup"],
+            }
+            for name, r in results.items()
+        }
         print(
             json.dumps(
                 {
@@ -412,15 +532,21 @@ def main():
                     "unit": "rows/s",
                     "vs_baseline": head["speedup"],
                     "detail": {
+                        "backend": full_detail["backend"],
+                        "n_devices": full_detail["n_devices"],
                         "rows": ROWS,
                         "shards": SHARDS,
-                        "backend": jax.default_backend(),
-                        "n_devices": len(jax.devices()),
-                        "configs": results,
-                        "total_s": round(time.time() - t_start, 1),
+                        "roundtrip_floor_ms": (
+                            None
+                            if floor_s is None
+                            else round(floor_s * 1e3, 1)
+                        ),
+                        "configs": compact_configs,
+                        "total_s": full_detail["total_s"],
                     },
                 }
-            )
+            ),
+            flush=True,
         )
     finally:
         for node in nodes:

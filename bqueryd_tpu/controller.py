@@ -612,8 +612,13 @@ class ControllerNode:
                 if k not in seen:
                     seen.add(k)
                     payloads.append(segment["results"][k])
+            # compact key: a batched shard-group is labelled by its first
+            # file + count, not the joined list (a 10-shard join produced a
+            # 130+ char key that bloated the bench's one-line JSON past what
+            # log tails keep intact)
             timings = {
-                "/".join(k): v for k, v in segment["timings"].items()
+                (k[0] if len(k) == 1 else f"{k[0]}+{len(k) - 1}more"): v
+                for k, v in segment["timings"].items()
             }
             reply = pickle.dumps(
                 {"ok": True, "payloads": payloads, "timings": timings},

@@ -56,13 +56,37 @@ def _null_mask(values):
     return jnp.zeros(values.shape, dtype=bool)
 
 
-#: rows per scatter block in the exact-int64 segment sum; bounds every block
-#: partial below 2^16 (max limb) * 2^14 = 2^30 < int32 overflow
-_SUM_BLOCK = 16384
+#: rows per scatter block in the exact-int64 segment sum.  A 16-bit limb's
+#: block sum stays below ``2^16 (max limb) * 2^16 (rows) = 2^32``: exactly
+#: representable in the int32 scatter's mod-2^32 arithmetic, recovered by a
+#: uint32 bitcast (unsigned limbs) or plain sign extension (the top limb,
+#: whose magnitude is bounded by 2^16 * 2^15 = 2^31).
+_SUM_BLOCK = 65536
 
 #: above this many scatter buckets (blocks x groups) the blocked decomposition
-#: stops paying for itself in HBM; fall back to the direct s64 scatter
+#: stops paying for itself in HBM; switch to the sort-based path
 _MAX_BLOCK_SEGMENTS = 1 << 25
+
+
+def _sorted_segment_sum(values, safe, n_groups):
+    """Exact per-group int64 sums at extreme cardinality: sort rows by group
+    code, prefix-sum the sorted values, and difference the prefix at group
+    boundaries.  One O(n log n) device sort + cheap elementwise s64 adds —
+    never an s64 scatter, and no ``blocks x groups`` table, so cost is
+    independent of ``n_groups`` (the blocked path's failure mode).  Wrapping
+    (mod 2^64) prefix sums difference back exactly, so the result is
+    bit-exact for the full int64 range."""
+    codes_s, order = lax.sort(
+        (safe, jnp.arange(safe.shape[0], dtype=jnp.int32)), num_keys=1
+    )
+    v_s = values[order].astype(jnp.int64)
+    prefix = jnp.cumsum(v_s)
+    # one past the last row of each group (== prefix index of its total)
+    ends = jnp.searchsorted(
+        codes_s, jnp.arange(n_groups, dtype=codes_s.dtype), side="right"
+    )
+    bounds = jnp.concatenate([jnp.zeros(1, jnp.int64), prefix])[ends]
+    return jnp.diff(jnp.concatenate([jnp.zeros(1, jnp.int64), bounds]))
 
 
 def _int64_segment_sum(values, valid, safe, n_groups):
@@ -74,43 +98,57 @@ def _int64_segment_sum(values, valid, safe, n_groups):
     (~5x the cost of the s32 scatter at 10 M rows, measured on v5e).  Instead:
     split values into 16-bit limbs (elementwise s64 ops are cheap — only the
     scatter is not), scatter each limb in int32 over ``blocks x groups``
-    buckets so no bucket can overflow, then reduce the per-block tables in
-    int64 and recombine limbs with shifts.  Bit-exact for the full int64
-    range."""
+    buckets, recover each bucket exactly (mod-2^32 wrap is invertible because
+    a block's true limb sum is < 2^32), then reduce the per-block tables in
+    uint64 and recombine limbs with shifts.  Bit-exact for the full int64
+    range.  Past ``_MAX_BLOCK_SEGMENTS`` buckets (~extreme group counts) the
+    sort-based path takes over instead of the emulated-s64 scatter that used
+    to cost ~3 s at 10 M rows."""
     n = values.shape[0]
     v = jnp.where(valid, values, 0)
     nbits = values.dtype.itemsize * 8
+    signed_in = jnp.issubdtype(values.dtype, jnp.signedinteger)
     n_blocks = -(-n // _SUM_BLOCK)
     if n_blocks * n_groups > _MAX_BLOCK_SEGMENTS:
-        return jax.ops.segment_sum(
-            v.astype(jnp.int64), safe, num_segments=n_groups
-        )
+        return _sorted_segment_sum(v, safe, n_groups)
+    # limbs: (int32 row, shift, signed). Non-top limbs are unsigned 16-bit
+    # slices; the top limb carries the sign for signed inputs.
     if nbits <= 16:
-        limbs = [(v.astype(jnp.int32), 0)]
+        limbs = [(v.astype(jnp.int32), 0, signed_in)]
     else:
         n_limbs = nbits // 16
         limbs = [
-            (((v >> (16 * i)) & 0xFFFF).astype(jnp.int32), 16 * i)
+            (((v >> (16 * i)) & 0xFFFF).astype(jnp.int32), 16 * i, False)
             for i in range(n_limbs - 1)
         ]
-        # top limb keeps the sign via arithmetic shift
+        # top limb keeps the sign via arithmetic shift (logical for unsigned)
         limbs.append(
             ((v >> (16 * (n_limbs - 1))).astype(jnp.int32),
-             16 * (n_limbs - 1))
+             16 * (n_limbs - 1), signed_in)
         )
     pad = n_blocks * _SUM_BLOCK - n
     safe_p = jnp.pad(safe, (0, pad))
     ids = (
         jnp.arange(n_blocks * _SUM_BLOCK, dtype=jnp.int32) // _SUM_BLOCK
     ) * n_groups + safe_p
-    total = jnp.zeros(n_groups, dtype=jnp.int64)
-    for limb, shift in limbs:
+    total = jnp.zeros(n_groups, dtype=jnp.uint64)
+    for limb, shift, signed in limbs:
         part = jax.ops.segment_sum(
             jnp.pad(limb, (0, pad)), ids, num_segments=n_blocks * n_groups
-        )
-        block_sums = part.reshape(n_blocks, n_groups).astype(jnp.int64).sum(0)
-        total = total + (block_sums << shift)
-    return total
+        ).reshape(n_blocks, n_groups)
+        if signed:
+            # |block sum| <= 2^16 * 2^15 = 2^31: no wrap, sign-extend
+            bs = part.astype(jnp.int64).astype(jnp.uint64).sum(0)
+        else:
+            # true block sum < 2^16 * 2^16 = 2^32: the int32 wrap is exactly
+            # the uint32 value
+            bs = (
+                lax.bitcast_convert_type(part, jnp.uint32)
+                .astype(jnp.uint64)
+                .sum(0)
+            )
+        total = total + (bs << jnp.uint64(shift))
+    return total.astype(jnp.int64)
 
 
 #: rows per MXU block: 8-bit limb block sums stay <= 32768 * 255 < 2^24, so

@@ -203,9 +203,16 @@ class MeshQueryExecutor:
     def _phase(self, name):
         import contextlib
 
-        if self.timer is None:
-            return contextlib.nullcontext()
-        return self.timer.phase(name)
+        from bqueryd_tpu.utils.tracing import trace_span
+
+        # every phase is both wall-timed (PhaseTimer -> reply phase_timings)
+        # and, under BQUERYD_TPU_PROFILE=1, a jax.profiler TraceAnnotation
+        # span so device timelines carry the query-phase names
+        stack = contextlib.ExitStack()
+        stack.enter_context(trace_span(name))
+        if self.timer is not None:
+            stack.enter_context(self.timer.phase(name))
+        return stack
 
     @staticmethod
     def supports(query: GroupByQuery):

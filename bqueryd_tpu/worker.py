@@ -590,7 +590,18 @@ class WorkerNode(WorkerBase):
             if data is not None:
                 timer.timings["result_cache"] = 0.0
         if data is None:
-            payload = self._execute(tables, query, timer)
+            import contextlib
+
+            profile_dir = os.environ.get("BQUERYD_TPU_PROFILE_DIR")
+            # opt-in: capture a full TensorBoard trace of this query
+            if profile_dir:
+                from bqueryd_tpu.utils.tracing import profiler_trace
+
+                profiling = profiler_trace(profile_dir)
+            else:
+                profiling = contextlib.nullcontext()
+            with profiling:
+                payload = self._execute(tables, query, timer)
             with timer.phase("serialize"):
                 data = payload.to_bytes()
             if cache is not None and len(data) <= cache.max_bytes // 8:

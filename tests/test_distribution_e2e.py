@@ -389,3 +389,106 @@ def test_full_distribution_pipeline_over_s3(pipeline, tmp_path):
     )
     expect = df.groupby("g")["v"].sum().to_dict()
     assert dict(zip(got["g"].tolist(), got["v_sum"].tolist())) == expect
+
+
+class FakeAzureBlobService:
+    """In-memory azure-storage-blob service double covering the surface
+    AzureBackend uses: get_blob_client(container, blob) -> client with
+    download_blob().chunks() and upload_blob() — mirroring FakeBoto3S3 so
+    the azure scheme gets the same coverage the reference gave its cloud
+    path via localstack (reference bqueryd/worker.py:519-556)."""
+
+    def __init__(self, chunk_size=128):
+        self.blobs = {}  # (container, blob) -> bytes
+        self.chunk_size = chunk_size
+
+    def get_blob_client(self, container, blob):
+        service = self
+
+        class BlobClient:
+            def upload_blob(self, fobj, overwrite=False):
+                key = (container, blob)
+                if not overwrite and key in service.blobs:
+                    raise ValueError(f"blob exists: {container}/{blob}")
+                service.blobs[key] = fobj.read()
+
+            def download_blob(self):
+                if (container, blob) not in service.blobs:
+                    raise KeyError(f"BlobNotFound: {container}/{blob}")
+                data = service.blobs[(container, blob)]
+                size = service.chunk_size
+
+                class Stream:
+                    @staticmethod
+                    def chunks():
+                        for i in range(0, len(data), size):
+                            yield data[i:i + size]
+
+                return Stream()
+
+        return BlobClient()
+
+
+def test_azure_backend_streams_chunks_with_progress(tmp_path):
+    """AzureBackend.fetch iterates the download stream's chunks, firing
+    progress_cb with CUMULATIVE byte counts after each one."""
+    from bqueryd_tpu.blob import AzureBackend
+
+    service = FakeAzureBlobService(chunk_size=128)
+    backend = AzureBackend(service=service)
+    payload = bytes(range(256)) * 2  # 512 bytes -> 4 chunks of 128
+    src = tmp_path / "obj"
+    src.write_bytes(payload)
+    backend.put("container", "shard.zip", str(src))
+    assert service.blobs[("container", "shard.zip")] == payload
+
+    seen = []
+    dest = tmp_path / "out"
+    backend.fetch("container", "shard.zip", str(dest), progress_cb=seen.append)
+    assert dest.read_bytes() == payload
+    assert seen == [128, 256, 384, 512]
+
+
+def test_full_distribution_pipeline_over_azure(pipeline, tmp_path):
+    """zip → upload_blob → download(wait=True, scheme='azure') → unzip →
+    two-phase activation → query, through the REAL AzureBackend code path
+    (fake service underneath) — parity with the S3 pipeline test above and
+    the reference's Azure downloader (reference bqueryd/worker.py:519-556)."""
+    from bqueryd_tpu.blob import AzureBackend
+    from bqueryd_tpu.download import METADATA_FILENAME
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.utils.net import zip_to_file
+
+    azure = AzureBackend(service=FakeAzureBlobService(chunk_size=64 * 1024))
+    pipeline["downloader"].blob_backend = azure
+
+    df = pd.DataFrame(
+        {
+            "g": np.arange(300, dtype=np.int64) % 5,
+            "v": np.arange(300, dtype=np.int64),
+        }
+    )
+    build = tmp_path / "build_azure"
+    build.mkdir()
+    src_root = build / "via_azure.bcolzs"
+    ctable.fromdataframe(df, str(src_root))
+    zip_path, _crc = zip_to_file(str(src_root), str(build))
+    azure.put("bcolz", "via_azure.bcolzs.zip", zip_path)
+
+    result = pipeline["rpc"].download(
+        filenames=["via_azure.bcolzs.zip"], bucket="bcolz", wait=True,
+        scheme="azure",
+    )
+    assert result == "DONE"
+    activated = pipeline["serving"] / "via_azure.bcolzs"
+    wait_until(activated.is_dir, desc="shard activated via azure path")
+    assert (activated / METADATA_FILENAME).is_file()
+    wait_until(
+        lambda: "via_azure.bcolzs" in pipeline["controller"].files_map,
+        desc="azure-distributed shard advertised",
+    )
+    got = pipeline["rpc"].groupby(
+        ["via_azure.bcolzs"], ["g"], [["v", "sum", "v_sum"]], []
+    )
+    expect = df.groupby("g")["v"].sum().to_dict()
+    assert dict(zip(got["g"].tolist(), got["v_sum"].tolist())) == expect

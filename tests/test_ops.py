@@ -121,6 +121,95 @@ def test_groupby_int64_sum_bit_exact():
     np.testing.assert_array_equal(got, expected)
 
 
+def _groupby_module():
+    # module names are shadowed by the function re-exports in bqueryd_tpu.ops
+    import sys
+
+    import bqueryd_tpu.ops.groupby  # noqa: F401
+
+    return sys.modules["bqueryd_tpu.ops.groupby"]
+
+
+def test_highcard_bench_shape_stays_on_blocked_path():
+    """Pin the chosen kernel route for BASELINE config 5 (10 M rows x 70,225
+    groups): with the 64 Ki scatter blocks the bucket count stays inside
+    ``_MAX_BLOCK_SEGMENTS``, so the exact int32 blocked scatter — not the
+    emulated-s64 fallback that cost ~3 s in round 3 — handles it."""
+    m = _groupby_module()
+    n_blocks = -(-10_000_000 // m._SUM_BLOCK)
+    assert n_blocks * 70_225 <= m._MAX_BLOCK_SEGMENTS
+
+
+def test_groupby_highcard_int64_sum_bit_exact():
+    """>=64k groups on the blocked-scatter path, full int64 range (block
+    limb sums exercise the mod-2^32 wrap recovery)."""
+    rng = np.random.default_rng(5)
+    n, n_groups = 300_000, 70_000
+    codes = rng.integers(0, n_groups, n).astype(np.int32)
+    info = np.iinfo(np.int64)
+    values = rng.integers(info.min // 4, info.max // 4, n).astype(np.int64)
+    values[:100] = info.max
+    values[100:200] = info.min
+    m = _groupby_module()
+    assert -(-n // m._SUM_BLOCK) * n_groups <= m._MAX_BLOCK_SEGMENTS
+    tables, _ = gb.groupby_aggregate(codes, (values,), ("sum",), n_groups)
+    expected = np.zeros(n_groups, dtype=np.int64)
+    with np.errstate(over="ignore"):
+        np.add.at(expected, codes, values)
+    np.testing.assert_array_equal(np.asarray(tables[0]), expected)
+
+
+def test_groupby_uint16_blocked_wrap_recovery(monkeypatch):
+    """A 64 Ki block of max uint16 values sums to 2^32 - 2^16: the int32
+    scatter wraps negative and the uint32 bitcast must recover it exactly.
+    The MXU route is disabled so the blocked scatter actually runs (at
+    n_groups=1 the matmul path would otherwise absorb this case)."""
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", "0")
+    n = 70_000  # > one block
+    codes = np.zeros(n, dtype=np.int32)
+    values = np.full(n, np.iinfo(np.uint16).max, dtype=np.uint16)
+    tables, _ = gb.groupby_aggregate(codes, (values,), ("sum",), 1)
+    assert int(np.asarray(tables[0])[0]) == n * 65535
+
+
+def test_sorted_segment_sum_bit_exact():
+    """The extreme-cardinality sort-based path, directly and via the public
+    API (forced by shrinking the bucket budget)."""
+    import jax.numpy as jnp
+
+    m = _groupby_module()
+    rng = np.random.default_rng(17)
+    n, n_groups = 50_000, 4_096
+    codes = rng.integers(0, n_groups, n).astype(np.int32)
+    info = np.iinfo(np.int64)
+    values = rng.integers(info.min // 2, info.max // 2, n).astype(np.int64)
+    expected = np.zeros(n_groups, dtype=np.int64)
+    with np.errstate(over="ignore"):
+        np.add.at(expected, codes, values)
+    got = m._sorted_segment_sum(jnp.asarray(values), jnp.asarray(codes), n_groups)
+    np.testing.assert_array_equal(np.asarray(got), expected)
+
+
+def test_int64_segment_sum_routes_to_sorted_past_budget(monkeypatch):
+    m = _groupby_module()
+    # disable the MXU route (37 groups would otherwise take the matmul path
+    # and never reach the scatter/sorted routing being pinned here) and
+    # shrink the bucket budget so the sorted path must serve the query
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", "0")
+    monkeypatch.setattr(m, "_MAX_BLOCK_SEGMENTS", 0)
+    rng = np.random.default_rng(23)
+    n, n_groups = 9_973, 37  # unique shape: avoids a stale jit cache entry
+    codes = rng.integers(0, n_groups, n).astype(np.int32)
+    values = rng.integers(-(2**60), 2**60, n).astype(np.int64)
+    tables, rows = gb.groupby_aggregate(codes, (values,), ("sum",), n_groups)
+    expected = np.zeros(n_groups, dtype=np.int64)
+    np.add.at(expected, codes, values)
+    np.testing.assert_array_equal(np.asarray(tables[0]), expected)
+    np.testing.assert_array_equal(
+        np.asarray(rows), np.bincount(codes, minlength=n_groups)
+    )
+
+
 def test_groupby_count_na():
     df = taxi_like_df()
     uniques, got, _ = run_groupby(df, "payment_type", "fare_amount", "count_na")
