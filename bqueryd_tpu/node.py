@@ -34,13 +34,24 @@ def main(argv=None):
     parser.add_argument(
         "role",
         nargs="?",
-        choices=["controller", "worker", "downloader", "movebcolz"],
-        help="daemon role; omit for an interactive RPC shell",
+        choices=["controller", "worker", "downloader", "movebcolz", "import"],
+        help=(
+            "daemon role, or 'import <src> <dst>' to convert a legacy "
+            "bcolz v1 rootdir; omit for an interactive RPC shell"
+        ),
     )
     parser.add_argument(
         "address",
         nargs="?",
-        help="controller address for the RPC shell (tcp://ip:port)",
+        help=(
+            "controller address for the RPC shell (tcp://ip:port); "
+            "source rootdir for 'import'"
+        ),
+    )
+    parser.add_argument(
+        "dest",
+        nargs="?",
+        help="destination rootdir for 'import'",
     )
     parser.add_argument("--data_dir", default=None)
     parser.add_argument(
@@ -66,7 +77,14 @@ def main(argv=None):
 
     kwargs = {"coordination_url": coordination_url, "loglevel": loglevel}
 
-    if args.role == "controller":
+    if args.role == "import":
+        if not args.address or not args.dest:
+            parser.error("import needs <src.bcolz> <dst.bcolz>")
+        from bqueryd_tpu.storage.bcolz_v1 import import_ctable
+
+        rows = import_ctable(args.address, args.dest)
+        print(f"imported {rows} rows: {args.address} -> {args.dest}")
+    elif args.role == "controller":
         from bqueryd_tpu.controller import ControllerNode
 
         ControllerNode(**kwargs).go()

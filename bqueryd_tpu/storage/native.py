@@ -16,6 +16,7 @@ TPC_ZLIB = 2
 
 _lib = None
 _searched = False
+_has_blosc = False
 
 
 def _candidate_paths():
@@ -92,6 +93,29 @@ def get_lib():
             ctypes.c_void_p,
             ctypes.c_int32,
         ]
+        # optional symbols: absent from libtpucolz builds predating the
+        # bcolz import feature — a stale lib must keep serving the query
+        # path, with blosc decoding falling back to pure Python
+        global _has_blosc
+        try:
+            lib.tpc_blosc_info.restype = ctypes.c_int32
+            lib.tpc_blosc_info.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.tpc_blosc_decode.restype = ctypes.c_size_t
+            lib.tpc_blosc_decode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+            _has_blosc = True
+        except AttributeError:
+            _has_blosc = False
         lib.tpc_factorize_i64.restype = ctypes.c_int64
         lib.tpc_factorize_i64.argtypes = [
             ctypes.c_void_p,
@@ -107,6 +131,12 @@ def get_lib():
 
 def available():
     return get_lib() is not None
+
+
+def blosc_available():
+    """True when the loaded lib carries the Blosc v1 decoder symbols (older
+    builds predate them; callers fall back to the Python decoder)."""
+    return get_lib() is not None and _has_blosc
 
 
 def encode(payload: bytes, elem_size: int, codec: int) -> bytes:
@@ -147,6 +177,30 @@ def decode_column(file_buf, offsets, usizes, elem_size, codec, out, nthreads):
     )
     if not ok:
         raise RuntimeError("tpc_decode_column failed (corrupt column?)")
+
+
+def blosc_info(buf: bytes):
+    """Parse a Blosc v1 chunk header: returns (nbytes, typesize, flags)."""
+    lib = get_lib()
+    nbytes = ctypes.c_int64()
+    typesize = ctypes.c_int32()
+    flags = ctypes.c_int32()
+    if not lib.tpc_blosc_info(
+        buf, len(buf),
+        ctypes.byref(nbytes), ctypes.byref(typesize), ctypes.byref(flags),
+    ):
+        raise ValueError("not a Blosc v1 chunk")
+    return nbytes.value, typesize.value, flags.value
+
+
+def blosc_decode(buf: bytes, usize: int) -> bytes:
+    """Decode one Blosc v1 chunk (legacy bcolz .blp files)."""
+    lib = get_lib()
+    dst = ctypes.create_string_buffer(usize)
+    got = lib.tpc_blosc_decode(buf, len(buf), dst, usize)
+    if got != usize:
+        raise ValueError("Blosc chunk decode failed (corrupt or unsupported)")
+    return dst.raw
 
 
 def factorize_i64(values: np.ndarray):

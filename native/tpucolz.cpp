@@ -211,6 +211,238 @@ size_t lz4_decompress(const uint8_t* src, size_t csize, uint8_t* dst,
   return op == usize ? op : 0;
 }
 
+// ---------------------------------------------------------------------------
+// BloscLZ decompressor (FastLZ-derived format used by c-blosc v1, the codec
+// behind legacy bcolz data).  Implemented from the public on-wire format:
+//
+//   stream := first_ctrl instr*
+//   first byte is masked with 31 (streams open with a literal run)
+//   literal run  (ctrl < 32):  copy (ctrl+1) bytes from input
+//   match        (ctrl >= 32): len = (ctrl>>5)-1, extended while bytes == 255
+//                              when the 3-bit field is 7; ofs = (ctrl&31)<<8
+//                              plus one code byte; code==255 with ofs==31<<8
+//                              switches to a 16-bit far distance (+8191);
+//                              copy len+3 bytes from op-ofs-code-1 (RLE run of
+//                              the previous byte when ofs==code==0)
+//   every instruction is followed by the next ctrl byte (if input remains)
+// ---------------------------------------------------------------------------
+
+constexpr size_t kBloscLZMaxDistance = 8191;
+
+// Returns bytes written (== usize expected by the chunk header) or 0 on
+// malformed/overflowing input.
+size_t blosclz_decompress(const uint8_t* src, size_t csize, uint8_t* dst,
+                          size_t dst_cap) {
+  if (csize == 0) return 0;
+  size_t ip = 0, op = 0;
+  uint32_t ctrl = src[ip++] & 31u;
+  for (;;) {
+    if (ctrl >= 32) {
+      size_t len = (ctrl >> 5) - 1;
+      size_t ofs = (ctrl & 31u) << 8;
+      if (len == 7 - 1) {  // 3-bit length field saturated: extend
+        uint8_t code;
+        do {
+          if (ip >= csize) return 0;
+          code = src[ip++];
+          len += code;
+        } while (code == 255);
+      }
+      if (ip >= csize) return 0;
+      uint8_t code = src[ip++];
+      size_t ref;  // index of first source byte, AFTER the implicit -1
+      if (code == 255 && ofs == (31u << 8)) {
+        if (ip + 2 > csize) return 0;
+        ofs = (static_cast<size_t>(src[ip]) << 8) + src[ip + 1];
+        ip += 2;
+        if (op < ofs + kBloscLZMaxDistance + 1) return 0;
+        ref = op - ofs - kBloscLZMaxDistance - 1;
+      } else {
+        if (op < ofs + code + 1) return 0;
+        ref = op - ofs - code - 1;
+      }
+      len += 3;
+      if (op + len > dst_cap) return 0;
+      if (ref + 1 == op) {
+        // RLE: run of the previous byte
+        std::memset(dst + op, dst[op - 1], len);
+      } else {
+        // may overlap forward: byte-wise copy is the defined semantics
+        for (size_t k = 0; k < len; ++k) dst[op + k] = dst[ref + k];
+      }
+      op += len;
+    } else {
+      size_t run = ctrl + 1;
+      if (ip + run > csize || op + run > dst_cap) return 0;
+      std::memcpy(dst + op, src + ip, run);
+      ip += run;
+      op += run;
+    }
+    if (ip >= csize) break;
+    ctrl = src[ip++];
+  }
+  return op;
+}
+
+// ---------------------------------------------------------------------------
+// Blosc v1 chunk container (the on-disk format of bcolz ".blp" chunk files).
+// Public header layout (16 bytes, little-endian):
+//   0 version | 1 versionlz | 2 flags | 3 typesize
+//   4-7 nbytes | 8-11 blocksize | 12-15 cbytes
+// flags: bit0 byte-shuffle, bit1 memcpyed, bit2 bit-shuffle, bit4 dont-split,
+//        bits5-7 codec (0 blosclz, 1 lz4/lz4hc, 3 zlib)
+// Non-memcpyed chunks: int32 bstarts[nblocks] table follows the header; each
+// block holds nsplits sub-streams, each preceded by its int32 csize (a csize
+// equal to the uncompressed split size means "stored raw").  Blocks shuffle
+// independently; nsplits == typesize for full blocks of splittable codecs
+// (mirrors c-blosc's split_block()), else 1.  Because split policy varied
+// across c-blosc releases (split-mode was a compressor-side option), each
+// block is decoded by trying the inferred split count first and the
+// alternative on failure — the int32-prefixed split framing makes a wrong
+// guess fail loudly, never decode garbage.
+// ---------------------------------------------------------------------------
+
+inline int32_t read_i32le(const uint8_t* p) {
+  uint32_t v = static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+               (static_cast<uint32_t>(p[2]) << 16) |
+               (static_cast<uint32_t>(p[3]) << 24);
+  int32_t out;
+  std::memcpy(&out, &v, 4);
+  return out;
+}
+
+enum BloscFlags : uint8_t {
+  kBloscShuffle = 0x1,
+  kBloscMemcpyed = 0x2,
+  kBloscBitShuffle = 0x4,
+};
+
+enum BloscCodec : int32_t {
+  kBloscLZCodec = 0,
+  kBloscLZ4Codec = 1,
+  kBloscZlibCodec = 3,
+};
+
+struct BloscHeader {
+  uint8_t flags = 0;
+  int32_t typesize = 0;
+  int32_t nbytes = 0;
+  int32_t blocksize = 0;
+  int32_t cbytes = 0;
+};
+
+bool parse_blosc_header(const uint8_t* src, size_t csize, BloscHeader* h) {
+  if (csize < 16) return false;
+  h->flags = src[2];
+  h->typesize = src[3];
+  h->nbytes = read_i32le(src + 4);
+  h->blocksize = read_i32le(src + 8);
+  h->cbytes = read_i32le(src + 12);
+  if (h->nbytes < 0 || h->blocksize <= 0 || h->cbytes < 16 ||
+      static_cast<size_t>(h->cbytes) > csize)
+    return false;
+  return true;
+}
+
+bool blosc_split_eligible(int32_t codec, size_t typesize, size_t bsize,
+                          bool leftover) {
+  if (leftover) return false;
+  if (codec != kBloscLZCodec && codec != kBloscLZ4Codec) return false;
+  return typesize > 1 && typesize <= 16 && bsize / typesize >= 128 &&
+         bsize % typesize == 0;
+}
+
+// Decode one block's split streams into block_dst.  Returns true when every
+// split's framing and codec stream are consistent.
+bool blosc_decode_block(const uint8_t* bp, size_t remain, size_t bsize,
+                        size_t nsplits, int32_t codec, uint8_t* block_dst) {
+  if (nsplits == 0 || bsize % nsplits != 0) return false;
+  const size_t neblock = bsize / nsplits;
+  for (size_t s = 0; s < nsplits; ++s) {
+    if (remain < 4) return false;
+    int32_t sc = read_i32le(bp);
+    bp += 4;
+    remain -= 4;
+    if (sc <= 0 || static_cast<size_t>(sc) > remain) return false;
+    const size_t scsize = static_cast<size_t>(sc);
+    uint8_t* sdst = block_dst + s * neblock;
+    if (scsize == neblock) {
+      std::memcpy(sdst, bp, neblock);  // stored raw
+    } else {
+      switch (codec) {
+        case kBloscLZCodec:
+          if (blosclz_decompress(bp, scsize, sdst, neblock) != neblock)
+            return false;
+          break;
+        case kBloscLZ4Codec:
+          if (lz4_decompress(bp, scsize, sdst, neblock) != neblock)
+            return false;
+          break;
+        case kBloscZlibCodec: {
+          uLongf out_len = static_cast<uLongf>(neblock);
+          if (uncompress(sdst, &out_len, bp, static_cast<uLong>(scsize)) !=
+                  Z_OK ||
+              out_len != neblock)
+            return false;
+          break;
+        }
+        default:
+          return false;
+      }
+    }
+    bp += scsize;
+    remain -= scsize;
+  }
+  return true;
+}
+
+// Decode one Blosc v1 chunk into dst (dst_cap >= header nbytes).  Returns
+// decoded byte count, or 0 on malformed input / unsupported feature
+// (bit-shuffle, unknown codec).
+size_t blosc_chunk_decode(const uint8_t* src, size_t csize, uint8_t* dst,
+                          size_t dst_cap) {
+  BloscHeader h;
+  if (!parse_blosc_header(src, csize, &h)) return 0;
+  const size_t nbytes = static_cast<size_t>(h.nbytes);
+  if (nbytes == 0) return 0;
+  if (dst_cap < nbytes) return 0;
+  if (h.flags & kBloscBitShuffle) return 0;  // not produced by legacy bcolz
+  if (h.flags & kBloscMemcpyed) {
+    if (csize < 16 + nbytes) return 0;
+    std::memcpy(dst, src + 16, nbytes);
+    return nbytes;
+  }
+  const int32_t codec = (h.flags >> 5) & 0x7;
+  const size_t blocksize = static_cast<size_t>(h.blocksize);
+  const size_t nblocks = (nbytes + blocksize - 1) / blocksize;
+  if (csize < 16 + 4 * nblocks) return 0;
+  const uint8_t* bstarts = src + 16;
+  const size_t typesize = static_cast<size_t>(h.typesize);
+  std::vector<uint8_t> tmp(blocksize);
+
+  for (size_t b = 0; b < nblocks; ++b) {
+    const size_t bsize =
+        (b == nblocks - 1) ? nbytes - b * blocksize : blocksize;
+    const bool leftover = bsize != blocksize;
+    int32_t start = read_i32le(bstarts + 4 * b);
+    if (start < 0 || static_cast<size_t>(start) > csize) return 0;
+    const uint8_t* bp = src + start;
+    size_t remain = csize - static_cast<size_t>(start);
+    const bool shuffled = (h.flags & kBloscShuffle) && typesize > 1;
+    uint8_t* block_dst = shuffled ? tmp.data() : dst + b * blocksize;
+
+    size_t primary =
+        blosc_split_eligible(codec, typesize, bsize, leftover) ? typesize : 1;
+    size_t fallback = primary == 1 ? typesize : 1;
+    if (!blosc_decode_block(bp, remain, bsize, primary, codec, block_dst) &&
+        (fallback == primary || fallback == 0 ||
+         !blosc_decode_block(bp, remain, bsize, fallback, codec, block_dst)))
+      return 0;
+    if (shuffled) unshuffle_bytes(tmp.data(), bsize, typesize, dst + b * blocksize);
+  }
+  return nbytes;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -332,6 +564,25 @@ int32_t tpc_decode_column(const uint8_t* file_buf, const uint64_t* offsets,
     for (auto& t : threads) t.join();
   }
   return ok.load();
+}
+
+// Peek a Blosc v1 chunk header (legacy bcolz ".blp" files): fills
+// uncompressed size, typesize and flags.  Returns 1 if the header parses.
+int32_t tpc_blosc_info(const uint8_t* src, size_t csize, int64_t* nbytes,
+                       int32_t* typesize, int32_t* flags) {
+  BloscHeader h;
+  if (!parse_blosc_header(src, csize, &h)) return 0;
+  if (nbytes) *nbytes = h.nbytes;
+  if (typesize) *typesize = h.typesize;
+  if (flags) *flags = h.flags;
+  return 1;
+}
+
+// Decode a Blosc v1 chunk (bcolz migration path).  Returns decoded bytes
+// (== header nbytes) or 0 on malformed/unsupported input.
+size_t tpc_blosc_decode(const uint8_t* src, size_t csize, uint8_t* dst,
+                        size_t dst_cap) {
+  return blosc_chunk_decode(src, csize, dst, dst_cap);
 }
 
 // Hash-factorize an int64 array: codes[i] = dense id of src[i] in first-seen

@@ -1,0 +1,378 @@
+"""Legacy bcolz v1 ingest: Blosc chunk decoding + carray/ctable readers +
+the ``bqueryd-tpu import`` conversion path.
+
+The fixture writer below emits the REAL bcolz v1 on-disk layout (carray dirs
+with meta/sizes + meta/storage JSON and Blosc v1 ``.blp`` chunks — the format
+served by the reference at reference bqueryd/worker.py:291).  Chunk payloads
+are produced three ways so the decoder is exercised on every container
+variant: memcpyed chunks, shuffled+split blosclz chunks, and unsplit chunks.
+Decoder correctness against the PUBLIC format (not just round-trip through
+our own compressor) is pinned by the hand-crafted byte-stream vectors in
+TestBloscLZVectors.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.storage import bcolz_v1
+from bqueryd_tpu.storage import native
+
+
+# ---------------------------------------------------------------------------
+# minimal blosclz COMPRESSOR (literal runs + RLE matches) for fixtures
+# ---------------------------------------------------------------------------
+
+def blosclz_compress_simple(data):
+    """Valid blosclz stream built from literal runs and distance-1 RLE
+    matches — enough to exercise the decoder's literal, match and RLE paths
+    on real fixture data (a full match-searching compressor is not needed
+    for ingest, only decode)."""
+    out = bytearray()
+    n = len(data)
+    i = 0
+
+    def emit_literals(chunk):
+        for s in range(0, len(chunk), 32):
+            piece = chunk[s:s + 32]
+            out.append(len(piece) - 1)
+            out.extend(piece)
+
+    lit_start = 0
+    while i < n:
+        # find an RLE run of >= 4 identical bytes (first byte stays literal)
+        run = 1
+        while i + run < n and data[i + run] == data[i] and run < 3 + 6 + 255 * 3:
+            run += 1
+        if run >= 4 and i >= lit_start:
+            emit_literals(data[lit_start:i + 1])  # include the seed byte
+            copy_len = run - 1  # bytes reproduced by the match
+            len_field = copy_len - 3
+            if len_field < 6:
+                out.append(((len_field + 1) << 5) | 0)
+                out.append(0)
+            else:
+                out.append((7 << 5) | 0)
+                rest = len_field - 6
+                while rest >= 255:
+                    out.append(255)
+                    rest -= 255
+                out.append(rest)
+                out.append(0)
+            i += run
+            lit_start = i
+        else:
+            i += 1
+    emit_literals(data[lit_start:n])
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Blosc v1 chunk builder (fixture side)
+# ---------------------------------------------------------------------------
+
+def _shuffle(data, typesize):
+    arr = np.frombuffer(data, dtype=np.uint8)
+    nelems = len(data) // typesize
+    head = arr[: nelems * typesize].reshape(nelems, typesize).T.reshape(-1)
+    return head.tobytes() + bytes(arr[nelems * typesize:])
+
+
+def build_blosc_chunk(data, typesize, mode="blosclz", blocksize=None):
+    """One Blosc v1 chunk: 16-byte header + bstarts + split streams."""
+    nbytes = len(data)
+    if mode == "memcpy":
+        header = struct.pack(
+            "<BBBBiii", 2, 1, 0x2, typesize, nbytes, nbytes, 16 + nbytes
+        )
+        return header + data
+    blocksize = blocksize or max(typesize, min(nbytes, 4096))
+    if blocksize % typesize:
+        blocksize += typesize - blocksize % typesize
+    flags = 0x1 if typesize > 1 else 0  # byte-shuffle
+    nblocks = -(-nbytes // blocksize)
+    streams = []
+    for b in range(nblocks):
+        raw = data[b * blocksize:(b + 1) * blocksize]
+        leftover = len(raw) != blocksize
+        if typesize > 1:
+            raw = _shuffle(raw, typesize)
+        splittable = (
+            not leftover
+            and 1 < typesize <= 16
+            and len(raw) % typesize == 0
+            and len(raw) // typesize >= 128
+        )
+        nsplits = typesize if splittable else 1
+        neblock = len(raw) // nsplits
+        parts = bytearray()
+        for s in range(nsplits):
+            piece = raw[s * neblock:(s + 1) * neblock]
+            comp = blosclz_compress_simple(piece)
+            if len(comp) < neblock:
+                parts += struct.pack("<i", len(comp)) + comp
+            else:
+                parts += struct.pack("<i", neblock) + piece  # stored raw
+        streams.append(bytes(parts))
+    bstarts = []
+    pos = 16 + 4 * nblocks
+    for s in streams:
+        bstarts.append(pos)
+        pos += len(s)
+    body = b"".join(streams)
+    cbytes = 16 + 4 * nblocks + len(body)
+    header = struct.pack(
+        "<BBBBiii", 2, 1, flags, typesize, nbytes, blocksize, cbytes
+    )
+    return header + b"".join(struct.pack("<i", b) for b in bstarts) + body
+
+
+# ---------------------------------------------------------------------------
+# bcolz v1 directory fixture writer
+# ---------------------------------------------------------------------------
+
+def write_bcolz_v1_carray(rootdir, values, chunklen=1000, mode="blosclz",
+                          raw_leftover=False):
+    values = np.ascontiguousarray(values)
+    os.makedirs(os.path.join(rootdir, "meta"))
+    os.makedirs(os.path.join(rootdir, "data"))
+    typesize = values.dtype.itemsize
+    with open(os.path.join(rootdir, "meta", "sizes"), "w") as f:
+        json.dump(
+            {"shape": [len(values)], "nbytes": values.nbytes, "cbytes": -1}, f
+        )
+    with open(os.path.join(rootdir, "meta", "storage"), "w") as f:
+        json.dump(
+            {
+                "dtype": str(values.dtype.str),
+                "cparams": {"clevel": 5, "shuffle": 1, "cname": "blosclz"},
+                "chunklen": chunklen,
+                "dflt": 0,
+                "expectedlen": len(values),
+            },
+            f,
+        )
+    nfull = len(values) // chunklen
+    for i in range(nfull):
+        chunk = values[i * chunklen:(i + 1) * chunklen].tobytes()
+        with open(os.path.join(rootdir, "data", f"__{i}.blp"), "wb") as f:
+            f.write(build_blosc_chunk(chunk, typesize, mode=mode))
+    left = values[nfull * chunklen:]
+    if len(left):
+        path = os.path.join(rootdir, "data", "__leftover.blp")
+        with open(path, "wb") as f:
+            if raw_leftover:
+                f.write(left.tobytes())
+            else:
+                f.write(build_blosc_chunk(left.tobytes(), typesize, mode=mode))
+
+
+def write_bcolz_v1_ctable(rootdir, frame, chunklen=1000, mode="blosclz"):
+    os.makedirs(rootdir)
+    with open(os.path.join(rootdir, "__attrs__"), "w") as f:
+        json.dump({"origin": "fixture"}, f)
+    with open(os.path.join(rootdir, "__cols__"), "w") as f:
+        json.dump({"names": list(frame.keys())}, f)
+    for name, values in frame.items():
+        write_bcolz_v1_carray(
+            os.path.join(rootdir, name), values, chunklen=chunklen, mode=mode
+        )
+
+
+# ---------------------------------------------------------------------------
+# hand-crafted blosclz streams: pin the decoder to the public format
+# ---------------------------------------------------------------------------
+
+def _decoders():
+    out = [("py", bcolz_v1._blosclz_decompress_py)]
+    if native.blosc_available():
+        def native_blosclz(src, usize):
+            # route through a 1-block unsplit chunk so the native stream
+            # decoder is reachable from public API: header, one bstart at
+            # offset 20, then the int32-framed split stream
+            header = struct.pack(
+                "<BBBBiii", 2, 1, 0, 1, usize, usize, 16 + 4 + 4 + len(src)
+            )
+            chunk = (
+                header
+                + struct.pack("<i", 20)
+                + struct.pack("<i", len(src))
+                + bytes(src)
+            )
+            return native.blosc_decode(chunk, usize)
+        out.append(("native", native_blosclz))
+    return out
+
+
+@pytest.mark.parametrize("name,decode", _decoders())
+class TestBloscLZVectors:
+    def test_literal_run(self, name, decode):
+        stream = bytes([4]) + b"hello"
+        assert decode(stream, 5) == b"hello"
+
+    def test_rle_match(self, name, decode):
+        # 'a' then a distance-1 match of 6 bytes: ctrl len field 3 -> 3+3=6
+        stream = bytes([0]) + b"a" + bytes([(4 << 5) | 0, 0])
+        assert decode(stream, 7) == b"aaaaaaa"
+
+    def test_overlapping_match(self, name, decode):
+        # "ab" then match dist 2 len 6 -> "abababab"
+        stream = bytes([1]) + b"ab" + bytes([(4 << 5) | 0, 1])
+        assert decode(stream, 8) == b"abababab"
+
+    def test_extended_length(self, name, decode):
+        # literal 'x' + RLE of 6+7+3 = 16 bytes: len field saturated (7),
+        # extension byte 7
+        stream = bytes([0]) + b"x" + bytes([(7 << 5) | 0, 7, 0])
+        assert decode(stream, 17) == b"x" * 17
+
+    def test_far_distance(self, name, decode):
+        # 9000 distinct-ish literal bytes, then a far match (dist > 8191+255)
+        body = bytes(range(256)) * 36  # 9216 bytes
+        body = body[:9000]
+        stream = bytearray()
+        for s in range(0, 9000, 32):
+            piece = body[s:s + 32]
+            stream.append(len(piece) - 1)
+            stream += piece
+        dist = 8500
+        extra = dist - bcolz_v1._MAX_DISTANCE - 1  # = 308
+        # copy length = ((ctrl>>5) - 1) + 3 = 5 for a length field of 3
+        stream += bytes([(3 << 5) | 31, 255, extra >> 8, extra & 0xFF])
+        expect = body + body[9000 - dist:9000 - dist + 5]
+        assert decode(bytes(stream), 9005) == bytes(expect)
+
+
+def test_python_and_native_chunk_decoders_agree():
+    rng = np.random.default_rng(7)
+    values = rng.integers(0, 50, 4096).astype(np.int64)
+    chunk = build_blosc_chunk(values.tobytes(), 8)
+    got_py = bcolz_v1._blosc_decode_chunk_py(chunk)
+    assert got_py == values.tobytes()
+    if native.blosc_available():
+        nbytes, typesize, flags = native.blosc_info(chunk)
+        assert (nbytes, typesize) == (values.nbytes, 8)
+        assert native.blosc_decode(chunk, nbytes) == values.tobytes()
+
+
+def test_memcpyed_chunk():
+    data = os.urandom(512)
+    chunk = build_blosc_chunk(data, 8, mode="memcpy")
+    assert bcolz_v1.decode_chunk(chunk) == data
+
+
+def test_unsplit_typesize_above_16():
+    # |S24 strings: typesize 24 > MAX_SPLITS -> single split stream
+    values = np.array(
+        [f"name-{i % 9:019d}".encode() for i in range(600)], dtype="|S24"
+    )
+    chunk = build_blosc_chunk(values.tobytes(), 24)
+    assert bcolz_v1.decode_chunk(chunk) == values.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# carray / ctable readers + import
+# ---------------------------------------------------------------------------
+
+def test_read_carray_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    values = rng.integers(-(2**40), 2**40, 2500).astype(np.int64)
+    write_bcolz_v1_carray(str(tmp_path / "c"), values, chunklen=1000)
+    got = bcolz_v1.read_carray(str(tmp_path / "c"))
+    np.testing.assert_array_equal(got, values)
+
+
+def test_read_carray_raw_leftover(tmp_path):
+    values = np.arange(1234, dtype=np.int32)
+    write_bcolz_v1_carray(
+        str(tmp_path / "c"), values, chunklen=1000, raw_leftover=True
+    )
+    got = bcolz_v1.read_carray(str(tmp_path / "c"))
+    np.testing.assert_array_equal(got, values)
+
+
+def _taxi_frame(n=3210):
+    rng = np.random.default_rng(11)
+    return {
+        "passenger_count": rng.integers(1, 9, n).astype(np.int64),
+        "fare_cents": rng.integers(250, 20000, n).astype(np.int64),
+        "trip_distance": (rng.random(n) * 30).astype(np.float64),
+        "vendor": np.array(
+            [("CMT", "VTS", "DDS")[i % 3] for i in range(n)], dtype="|S3"
+        ),
+    }
+
+
+def test_read_ctable_matches_pandas(tmp_path):
+    frame = _taxi_frame()
+    src = str(tmp_path / "legacy.bcolz")
+    write_bcolz_v1_ctable(src, frame)
+    columns, attrs = bcolz_v1.read_ctable(src)
+    assert list(columns) == list(frame)  # __cols__ order preserved
+    assert attrs == {"origin": "fixture"}
+    for name in frame:
+        np.testing.assert_array_equal(columns[name], frame[name])
+
+
+def test_import_ctable_end_to_end(tmp_path):
+    """The VERDICT's done-bar: convert a legacy rootdir and assert
+    logical-value equality against a pandas load of the source data,
+    through the converted table's own query surface."""
+    from bqueryd_tpu.storage.ctable import ctable
+
+    frame = _taxi_frame()
+    src = str(tmp_path / "legacy.bcolz")
+    dst = str(tmp_path / "converted.bcolz")
+    write_bcolz_v1_ctable(src, frame)
+
+    rows = bcolz_v1.import_ctable(src, dst)
+    assert rows == len(frame["fare_cents"])
+
+    t = ctable(dst)
+    source_df = pd.DataFrame(
+        {
+            k: (np.char.decode(v, "utf-8") if v.dtype.kind == "S" else v)
+            for k, v in frame.items()
+        }
+    )
+    for name in frame:
+        np.testing.assert_array_equal(
+            np.asarray(t.column(name)), source_df[name].to_numpy()
+        )
+    # converted data answers queries bit-exactly vs pandas
+    from bqueryd_tpu.models.query import GroupByQuery, QueryEngine
+    from bqueryd_tpu.parallel import hostmerge
+
+    q = GroupByQuery(
+        ["passenger_count"], [["fare_cents", "sum", "s"]], [], aggregate=True
+    )
+    payload = QueryEngine().execute_local(t, q)
+    df = hostmerge.payload_to_dataframe(
+        hostmerge.merge_payloads([payload])
+    ).sort_values("passenger_count")
+    expect = (
+        source_df.groupby("passenger_count")["fare_cents"].sum().sort_index()
+    )
+    np.testing.assert_array_equal(
+        df["s"].to_numpy(), expect.to_numpy()
+    )
+    assert t.attrs.get("bcolz_v1_attrs") == {"origin": "fixture"}
+
+
+def test_cli_import(tmp_path):
+    from bqueryd_tpu.node import main
+
+    frame = {"a": np.arange(50, dtype=np.int64)}
+    src = str(tmp_path / "legacy.bcolz")
+    dst = str(tmp_path / "out.bcolz")
+    write_bcolz_v1_ctable(src, frame, chunklen=16)
+    assert main(["import", src, dst]) == 0
+    from bqueryd_tpu.storage.ctable import ctable
+
+    np.testing.assert_array_equal(
+        np.asarray(ctable(dst).column("a")), frame["a"]
+    )
