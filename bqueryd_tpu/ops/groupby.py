@@ -215,7 +215,11 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
 
         return _partial_tables_mm(
             codes, measures, ops, int(n_groups), mask,
-            use_pallas=pallas_groupby.pallas_enabled(),
+            # the Pallas kernel has its own (VMEM-bound) cardinality ceiling:
+            # a raised BQUERYD_TPU_MATMUL_GROUPS must not push it past the
+            # group count where its smallest one-hot tile still fits
+            use_pallas=pallas_groupby.pallas_enabled()
+            and int(n_groups) <= pallas_groupby.pallas_groups_limit(),
         )
     return _partial_tables_scatter(codes, measures, ops, int(n_groups), mask)
 

@@ -78,13 +78,30 @@ def _make_kernel(n_rows, n_groups, tile_k):
     return kernel
 
 
+#: smallest inner K tile worth feeding the MXU; also sets the group-count
+#: ceiling of the Pallas route (see :func:`pallas_groups_limit`)
+_MIN_TILE = 128
+
+#: bf16 one-hot tile budget in elements (~4 MB of the ~16 MB VMEM)
+_ONEHOT_BUDGET = 1 << 21
+
+
+def pallas_groups_limit():
+    """Max group count the kernel can run without its smallest one-hot tile
+    overflowing the VMEM budget: above this the caller must stay on the XLA
+    path (which it does anyway past ``matmul_groups_limit`` unless the env
+    knob raised it — the round-3 VMEM hole was exactly that combination)."""
+    return _ONEHOT_BUDGET // _MIN_TILE
+
+
 def _tile_k(n_groups):
-    """Largest inner K tile whose bf16 one-hot stays within ~4 MB of VMEM.
+    """Largest inner K tile whose bf16 one-hot stays within ~4 MB of VMEM,
+    shrinking to ``_MIN_TILE`` at high group counts.
 
     Restricted to powers of two so the tile always divides ``BLOCK_K`` —
     a non-divisor would truncate the block loop and silently drop rows."""
-    budget = (1 << 21) // max(n_groups, 128)
-    tile = 256
+    budget = _ONEHOT_BUDGET // max(n_groups, 128)
+    tile = _MIN_TILE
     while tile * 2 <= min(budget, 2048):
         tile *= 2
     return tile
@@ -124,6 +141,14 @@ def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
     Returns float32[nb, R8, G128] where R8/G128 are R and n_groups rounded up
     to hardware tile multiples — callers slice ``[:, :R, :G]``.
     """
+    if n_groups > pallas_groups_limit():
+        # the invariant lives here, not only in the dispatcher's boolean:
+        # past this cardinality even the smallest one-hot tile overflows the
+        # VMEM budget, and Mosaic's failure mode is an opaque exhaustion
+        raise ValueError(
+            f"n_groups={n_groups} exceeds the Pallas kernel's VMEM ceiling "
+            f"({pallas_groups_limit()}); use the XLA path"
+        )
     n = codes.shape[0]
     npad = _round_up(max(n, 1), BLOCK_K)
     rpad = _round_up(n_rows, _SUBLANE)

@@ -134,6 +134,7 @@ def test_pallas_kernel_matches_xla_path(op, g, monkeypatch):
             ops.partial_tables(codes, (vals,), (op,), g, mask=mask)
         )
 
+    monkeypatch.delenv("BQUERYD_TPU_PALLAS", raising=False)
     xla = run()
     monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
     pallas = run()
@@ -143,6 +144,65 @@ def test_pallas_kernel_matches_xla_path(op, g, monkeypatch):
             xla["aggs"][0][key], pallas["aggs"][0][key],
             err_msg=f"op={op} partial={key}",
         )
+
+
+def test_pallas_high_cardinality_tile_shrinks(monkeypatch):
+    """Above 8192 groups the one-hot tile must shrink to _MIN_TILE instead of
+    overflowing the VMEM budget (the round-3 hole: _tile_k bottomed at 256,
+    so raising BQUERYD_TPU_MATMUL_GROUPS past ~8k overflowed ~4 MB)."""
+    import jax
+
+    from bqueryd_tpu import ops
+    from bqueryd_tpu.ops import pallas_groupby as pg
+
+    g = 12_289  # > the old 8k ceiling, <= pallas_groups_limit()
+    assert g <= pg.pallas_groups_limit()
+    tile = pg._tile_k(g)
+    assert tile == pg._MIN_TILE
+    assert tile * g <= pg._ONEHOT_BUDGET
+    assert pg.BLOCK_K % tile == 0
+
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", "16384")
+    rng = np.random.RandomState(3)
+    n = pg.BLOCK_K  # one grid block keeps interpret mode fast
+    codes = rng.randint(-1, g, n).astype(np.int32)
+    vals = rng.randint(-(2**40), 2**40, n).astype(np.int64)
+
+    def run():
+        return jax.device_get(ops.partial_tables(codes, (vals,), ("sum",), g))
+
+    monkeypatch.delenv("BQUERYD_TPU_PALLAS", raising=False)
+    xla = run()
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    pallas = run()
+    np.testing.assert_array_equal(xla["rows"], pallas["rows"])
+    np.testing.assert_array_equal(
+        xla["aggs"][0]["sum"], pallas["aggs"][0]["sum"]
+    )
+
+
+def test_pallas_route_capped_at_groups_limit(monkeypatch):
+    """Past pallas_groups_limit() the dispatcher must keep the XLA dot even
+    with BQUERYD_TPU_PALLAS=1 (no VMEM-overflowing kernel launch)."""
+    from bqueryd_tpu.ops import groupby as gbm
+    from bqueryd_tpu.ops import pallas_groupby as pg
+
+    g = pg.pallas_groups_limit() + 1
+    seen = {}
+    real = gbm._partial_tables_mm
+
+    def spy(codes, measures, ops_, n_groups, mask=None, use_pallas=False):
+        seen["use_pallas"] = use_pallas
+        return real(codes, measures, ops_, n_groups, mask,
+                    use_pallas=use_pallas)
+
+    monkeypatch.setattr(gbm, "_partial_tables_mm", spy)
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_GROUPS", str(g))
+    codes = np.arange(64, dtype=np.int32) % g
+    vals = np.ones(64, dtype=np.int64)
+    gbm.partial_tables(codes, (vals,), ("sum",), g)
+    assert seen["use_pallas"] is False
 
 
 def _worker_for(tmp_path, mem_store_url):
