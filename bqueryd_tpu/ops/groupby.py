@@ -354,6 +354,15 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
     if use_pallas:
         from bqueryd_tpu.ops import pallas_groupby
 
+        # the dispatcher's gate only knew n_groups; the stacked row count is
+        # known here, so demote to the XLA dot when the full working set
+        # (rows x groups scratch + lhs blocks) would overflow VMEM.  Static
+        # python branch: len(rows) and n_groups are trace-time constants.
+        if not pallas_groupby.fits_vmem(len(rows), n_groups):
+            use_pallas = False
+    if use_pallas:
+        from bqueryd_tpu.ops import pallas_groupby
+
         # fused VMEM kernel: one-hot tiles formed on the fly, never in HBM
         out = pallas_groupby.onehot_rows_dot(
             folded,

@@ -94,6 +94,32 @@ def pallas_groups_limit():
     return _ONEHOT_BUDGET // _MIN_TILE
 
 
+#: total VMEM the kernel may plan for (v5e has ~16 MB; leave headroom for
+#: Mosaic's own buffers)
+_VMEM_BUDGET_BYTES = 12 << 20
+
+
+def fits_vmem(n_rows, n_groups):
+    """Whether the kernel's working set fits the VMEM budget for this shape.
+
+    The group ceiling alone is not enough: the f32 accumulator scratch and
+    output block scale with ``n_rows * n_groups`` (many stacked limb rows at
+    high cardinality can exhaust VMEM even under the one-hot ceiling), and
+    the double-buffered lhs block with ``n_rows * BLOCK_K``."""
+    if n_groups > pallas_groups_limit():
+        return False
+    rpad = _round_up(max(n_rows, 1), _SUBLANE)
+    gpad = _round_up(max(n_groups, 1), 128)
+    tile = _tile_k(gpad)
+    need = (
+        tile * gpad * 2            # bf16 one-hot tile
+        + 2 * rpad * gpad * 4      # f32 accumulator scratch + output block
+        + 2 * rpad * BLOCK_K * 2   # double-buffered bf16 lhs block
+        + 2 * BLOCK_K * 4          # double-buffered i32 codes block
+    )
+    return need <= _VMEM_BUDGET_BYTES
+
+
 def _tile_k(n_groups):
     """Largest inner K tile whose bf16 one-hot stays within ~4 MB of VMEM,
     shrinking to ``_MIN_TILE`` at high group counts.
@@ -141,13 +167,13 @@ def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
     Returns float32[nb, R8, G128] where R8/G128 are R and n_groups rounded up
     to hardware tile multiples — callers slice ``[:, :R, :G]``.
     """
-    if n_groups > pallas_groups_limit():
+    if not fits_vmem(n_rows, n_groups):
         # the invariant lives here, not only in the dispatcher's boolean:
-        # past this cardinality even the smallest one-hot tile overflows the
-        # VMEM budget, and Mosaic's failure mode is an opaque exhaustion
+        # past this shape the working set overflows the VMEM budget, and
+        # Mosaic's failure mode is an opaque exhaustion
         raise ValueError(
-            f"n_groups={n_groups} exceeds the Pallas kernel's VMEM ceiling "
-            f"({pallas_groups_limit()}); use the XLA path"
+            f"n_rows={n_rows} x n_groups={n_groups} exceeds the Pallas "
+            "kernel's VMEM budget; use the XLA path"
         )
     n = codes.shape[0]
     npad = _round_up(max(n, 1), BLOCK_K)
