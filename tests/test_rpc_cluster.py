@@ -5,6 +5,7 @@ tests/test_simple_rpc.py:42-74, with condition polling instead of sleeps)."""
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 import pandas as pd
@@ -380,10 +381,28 @@ def test_worker_error_aborts_query(cluster, data_dir):
 def test_loglevel_fanout(cluster):
     import bqueryd_tpu
 
+    # the verb fans out asynchronously (controller applies it synchronously,
+    # workers on their next poll tick), and every node shares this process's
+    # root logger — poll until the last fan-out echo settles
     assert cluster["rpc"].loglevel("debug") == "OK"
-    assert bqueryd_tpu.logger.level == logging.DEBUG
+    wait_until(
+        lambda: bqueryd_tpu.logger.level == logging.DEBUG,
+        desc="loglevel debug applied",
+    )
     cluster["rpc"].loglevel("info")
-    assert bqueryd_tpu.logger.level == logging.INFO
+    # stability, not a fixed sleep: every fan-out echo (controller + 3
+    # worker roles) must have applied 'info' — poll until the level has
+    # held INFO continuously for half a second
+    stable_since = [None]
+
+    def held_info():
+        if bqueryd_tpu.logger.level != logging.INFO:
+            stable_since[0] = None
+            return False
+        if stable_since[0] is None:
+            stable_since[0] = time.time()
+        return time.time() - stable_since[0] >= 0.5
+    wait_until(held_info, desc="loglevel info applied and stable")
 
 
 def test_batched_dispatch_merges_on_worker(cluster, taxi_df):
