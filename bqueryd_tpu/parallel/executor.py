@@ -411,13 +411,14 @@ class MeshQueryExecutor:
                 measures_d.append(arr)
 
         with self._phase("aggregate"):
+            # returns host numpy partials; with packed fetch (default) the
+            # whole merged pytree comes back as ONE device buffer — per-leaf
+            # pulls cost a full transport round-trip each on tunneled/remote
+            # devices
             merged = _mesh_partials(
                 mesh, self.axis_name, query.ops, n_groups,
                 codes_d, tuple(measures_d),
             )
-            # ONE batched pytree fetch: per-leaf pulls cost a full transport
-            # round-trip each (painful on tunneled/remote devices)
-            merged = jax.device_get(merged)
 
         with self._phase("collect"):
             rows = merged["rows"]
@@ -458,13 +459,60 @@ class MeshQueryExecutor:
             )
 
 
+def _pack_leaf(leaf):
+    """Bitcast any result leaf to its native bytes (lossless, no widening —
+    the packed buffer carries exactly the leaves' own byte sizes)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if leaf.dtype.itemsize == 1:
+        return leaf.astype(jnp.uint8).ravel() if leaf.dtype != jnp.uint8 \
+            else leaf.ravel()
+    # bitcast to a SMALLER dtype appends a trailing byte axis
+    return lax.bitcast_convert_type(leaf, jnp.uint8).ravel()
+
+
+def _unpack_host(flat, spec):
+    """Invert :func:`_pack_leaf` on the fetched numpy uint8 byte buffer."""
+    leaves = []
+    off = 0
+    for dtype, shape in spec:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = n * dtype.itemsize
+        seg = flat[off:off + nbytes]
+        off += nbytes
+        # copy() realigns the slice so the view is valid at any offset
+        leaves.append(seg.copy().view(dtype).reshape(shape))
+    return leaves
+
+
+def packed_fetch_enabled():
+    """Fetch the merged result as ONE device buffer (default on): the merged
+    pytree has one leaf per aggregation partial, and ``jax.device_get``
+    copies leaves buffer-by-buffer — on a remote/tunneled backend each copy
+    is a transport round-trip, turning a 2 ms kernel into tens of ms of
+    fetch latency.  Packing bitcasts every leaf to its native bytes and
+    concatenates INSIDE the compiled mesh program, so dispatch+fetch is
+    exactly one program and one buffer of the leaves' own total size."""
+    return os.environ.get("BQUERYD_TPU_PACKED_FETCH", "1") == "1"
+
+
 @functools.lru_cache(maxsize=64)
-def _mesh_program(mesh, axis, agg_ops, n_groups, n_measures):
-    """Build + cache the jitted shard_map program for one query shape."""
+def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack):
+    """Build + cache the jitted shard_map program for one query shape.
+
+    The key carries everything that can change the traced program — measure
+    wire dtypes AND the per-device row width (``in_width``): the packed
+    output's host-side unpack spec is captured at trace time, and both leaf
+    dtypes (via the measure dtypes) and the kernel route (via the row count,
+    ``_matmul_cells_limit``) feed it, so one cache entry must map to exactly
+    one trace."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from bqueryd_tpu import ops
+
+    spec = {}  # populated at trace time: treedef + (dtype, shape) per leaf
 
     def block_fn(codes_blk, *measure_blks):
         partials = ops.partial_tables(
@@ -473,23 +521,45 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, n_measures):
             agg_ops,
             n_groups,
         )
-        return ops.psum_partials(partials, axis)
+        merged = ops.psum_partials(partials, axis)
+        if not pack:
+            return merged
+        leaves, treedef = jax.tree_util.tree_flatten(merged)
+        spec["treedef"] = treedef
+        spec["leaves"] = tuple(
+            (np.dtype(leaf.dtype), tuple(leaf.shape)) for leaf in leaves
+        )
+        import jax.numpy as jnp
+
+        return jnp.concatenate([_pack_leaf(leaf).ravel() for leaf in leaves])
 
     fn = jax.shard_map(
         block_fn,
         mesh=mesh,
-        in_specs=tuple([P(axis, None)] * (1 + n_measures)),
+        in_specs=tuple([P(axis, None)] * len(in_dtypes)),
         out_specs=P(),
         # pallas_call outputs carry no varying-mesh-axes metadata, so the vma
         # check would reject the kernel path; the psum in block_fn is what
         # makes the out_specs=P() replication true by construction
         check_vma=False,
     )
-    return jax.jit(fn)
+    return jax.jit(fn), spec
 
 
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d):
-    program = _mesh_program(
-        mesh, axis, tuple(agg_ops), int(n_groups), len(measures_d)
+    """Run the mesh program and return the merged partials pytree ON HOST
+    (numpy leaves) — fetching one packed buffer when packing is enabled."""
+    import jax
+
+    pack = packed_fetch_enabled()
+    in_dtypes = (str(codes_d.dtype),) + tuple(str(m.dtype) for m in measures_d)
+    program, spec = _mesh_program(
+        mesh, axis, tuple(agg_ops), int(n_groups), in_dtypes,
+        int(codes_d.shape[1]), pack,
     )
-    return program(codes_d, *measures_d)
+    out = program(codes_d, *measures_d)
+    if not pack:
+        return jax.device_get(out)
+    flat = np.asarray(jax.device_get(out))
+    leaves = _unpack_host(flat, spec["leaves"])
+    return jax.tree_util.tree_unflatten(spec["treedef"], leaves)

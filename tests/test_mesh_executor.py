@@ -233,3 +233,124 @@ def test_rejects_non_mergeable_ops(sharded, mesh):
     assert not MeshQueryExecutor.supports(
         GroupByQuery(["VendorID"], [["fare_amount", "sum", "s"]], aggregate=False)
     )
+
+
+def test_packed_fetch_matches_unpacked(tmp_path, monkeypatch):
+    """The single-buffer packed fetch (bitcast-to-uint64 concat inside the
+    mesh program) must be lossless for every partial dtype: int64 sums,
+    float32/float64 sums, counts, and min/max carried on narrowed wire
+    dtypes (int8/int16)."""
+    import pandas as pd
+
+    from bqueryd_tpu.models.query import GroupByQuery
+    from bqueryd_tpu.parallel import executor as ex
+    from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+    from bqueryd_tpu.storage.ctable import ctable
+
+    rng = np.random.default_rng(21)
+    n = 4000
+    df = pd.DataFrame(
+        {
+            "g": rng.integers(0, 9, n).astype(np.int64),
+            "big": rng.integers(-(2**60), 2**60, n).astype(np.int64),
+            "small": rng.integers(-100, 100, n).astype(np.int64),  # int8 wire
+            "f32": (rng.random(n) * 100).astype(np.float32),
+            "f64": rng.random(n).astype(np.float64),
+        }
+    )
+    tables = []
+    for i in range(3):
+        root = str(tmp_path / f"p{i}.bcolzs")
+        ctable.fromdataframe(df.iloc[i::3], root)
+        tables.append(ctable(root))
+    query = GroupByQuery(
+        ["g"],
+        [
+            ["big", "sum", "s"],
+            ["small", "min", "lo"],
+            ["small", "max", "hi"],
+            ["f32", "mean", "m32"],
+            ["f64", "sum", "s64"],
+            ["big", "count", "n"],
+        ],
+        [],
+        aggregate=True,
+    )
+
+    def run():
+        ex._mesh_program.cache_clear()
+        return MeshQueryExecutor().execute(tables, query)
+
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "1")
+    packed = run()
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "0")
+    unpacked = run()
+    from bqueryd_tpu.parallel import hostmerge
+
+    df_p = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([packed]))
+    df_u = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([unpacked]))
+    pd.testing.assert_frame_equal(
+        df_p.sort_values("g").reset_index(drop=True),
+        df_u.sort_values("g").reset_index(drop=True),
+    )
+    expect = df.groupby("g")["big"].sum().sort_index()
+    np.testing.assert_array_equal(
+        df_p.sort_values("g")["s"].to_numpy(), expect.to_numpy()
+    )
+
+
+def test_packed_fetch_spec_stable_across_kernel_routes(tmp_path, monkeypatch):
+    """Two row counts can route the SAME query shape through different
+    kernels (MXU vs scatter past BQUERYD_TPU_MATMUL_CELLS), whose float
+    partial dtypes differ (f64 vs f32).  Each width must decode with its
+    own trace's spec — re-running the small query after the large one must
+    not corrupt its float aggregates (the shared-spec retrace bug)."""
+    import pandas as pd
+
+    from bqueryd_tpu.models.query import GroupByQuery
+    from bqueryd_tpu.parallel import hostmerge
+    from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+    from bqueryd_tpu.storage.ctable import ctable
+
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "1")
+    # rows*groups above this forces the scatter route for the LARGE table
+    monkeypatch.setenv("BQUERYD_TPU_MATMUL_CELLS", str(5000 * 7))
+
+    rng = np.random.default_rng(31)
+
+    def build(name, n):
+        df = pd.DataFrame(
+            {
+                "g": rng.integers(0, 7, n).astype(np.int64),
+                "v": (rng.random(n) * 100).astype(np.float32),
+            }
+        )
+        root = str(tmp_path / name)
+        ctable.fromdataframe(df, root)
+        return df, [ctable(root)]
+
+    df_small, small = build("small.bcolz", 2000)
+    df_large, large = build("large.bcolz", 60_000)
+    query = GroupByQuery(["g"], [["v", "mean", "m"]], [], aggregate=True)
+    executor = MeshQueryExecutor()
+
+    def result_means(tables):
+        payload = executor.execute(tables, query)
+        df = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads([payload])
+        )
+        return df.sort_values("g")["m"].to_numpy()
+
+    def expect_means(df):
+        return df.groupby("g")["v"].mean().sort_index().to_numpy()
+
+    np.testing.assert_allclose(
+        result_means(small), expect_means(df_small), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        result_means(large), expect_means(df_large), rtol=1e-6
+    )
+    # the hazard: small again, after large's trace populated the cache
+    np.testing.assert_allclose(
+        result_means(small), expect_means(df_small), rtol=1e-6
+    )
