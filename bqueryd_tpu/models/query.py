@@ -200,6 +200,72 @@ class ResultPayload(dict):
         return cls(obj)
 
 
+_measured_floor = None
+
+
+def device_dispatch_floor(remeasure=False):
+    """Measured wall of one trivial jitted dispatch + host fetch on the
+    default backend (min of 3, cached per process).  On a remote/tunneled
+    device this is tens of ms of pure transport; on local hardware,
+    microseconds.  The fetch is included because the device query path ends
+    in a ``device_get`` — that is the cost host routing competes against.
+
+    A measurement taken while another thread holds the backend (e.g. the
+    worker's background warmup compile) is inflated; the warmup thread
+    calls ``remeasure=True`` when it finishes to replace any such sample."""
+    global _measured_floor
+    if _measured_floor is None or remeasure:
+        import time
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        f = jax.jit(lambda x: x + 1)
+        np.asarray(f(jnp.zeros(())))
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(jnp.zeros(())))
+            walls.append(time.perf_counter() - t0)
+        _measured_floor = min(walls)
+    return _measured_floor
+
+
+#: assumed host aggregation cost per row (factorize + limb bincounts),
+#: used only to convert the measured dispatch floor into a row threshold
+_HOST_NS_PER_ROW = 20e-9
+
+#: never host-route queries above this many rows, however slow the device
+#: link — large queries belong on the accelerator
+_HOST_ROUTE_CAP = 4_000_000
+
+
+def host_kernel_rows():
+    """Row threshold below which mergeable aggregations run on the HOST
+    (:func:`ops.host_partial_tables`) instead of paying a device round-trip.
+
+    Latency-aware routing: when the device sits behind a network tunnel the
+    dispatch+fetch floor dwarfs the kernel for small inputs, so the host is
+    strictly faster; on local chips the measured floor is microseconds and
+    the threshold collapses to ~10k rows.  Override with
+    BQUERYD_TPU_HOST_KERNEL_ROWS (0 disables host routing)."""
+    env = os.environ.get("BQUERYD_TPU_HOST_KERNEL_ROWS")
+    if env is not None:
+        try:
+            return max(int(env), 0)
+        except ValueError:
+            import logging
+
+            logging.getLogger("bqueryd_tpu").warning(
+                "unparseable BQUERYD_TPU_HOST_KERNEL_ROWS=%r, "
+                "host routing disabled", env,
+            )
+            return 0
+    return min(int(device_dispatch_floor() / _HOST_NS_PER_ROW),
+               _HOST_ROUTE_CAP)
+
+
 class QueryEngine:
     """Executes queries against local tpucolz tables on the local JAX device
     (single-device path; the multi-device mesh path lives in
@@ -282,14 +348,23 @@ class QueryEngine:
                     table.column_raw(a[0]) for _, a in mergeable
                 )
                 mops = tuple(a[1] for _, a in mergeable)
-                import jax
-
-                partials = jax.device_get(  # ONE batched D2H round-trip
-                    ops.partial_tables(
+                if len(dense) <= host_kernel_rows():
+                    # latency-aware routing: below the threshold the host
+                    # beats the device's dispatch+fetch floor (see
+                    # host_kernel_rows); identical partial semantics
+                    partials = ops.host_partial_tables(
                         dense.astype(np.int32), measures, mops, n_groups,
                         mask_arr,
                     )
-                )
+                else:
+                    import jax
+
+                    partials = jax.device_get(  # ONE batched D2H round-trip
+                        ops.partial_tables(
+                            dense.astype(np.int32), measures, mops, n_groups,
+                            mask_arr,
+                        )
+                    )
                 rows = partials["rows"]
                 for (i, _a), part in zip(mergeable, partials["aggs"]):
                     agg_parts[i] = dict(part)

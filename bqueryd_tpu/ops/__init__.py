@@ -85,6 +85,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     groupby_aggregate,
     groupby_count_distinct,
     groupby_sorted_count_distinct,
+    host_partial_tables,
     partial_tables,
     psum_partials,
 )
@@ -108,6 +109,7 @@ __all__ = [
     "groupby_count_distinct",
     "groupby_sorted_count_distinct",
     "expand_mask_by_group",
+    "host_partial_tables",
     "partial_tables",
     "combine_partials",
     "psum_partials",

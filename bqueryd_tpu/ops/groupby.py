@@ -501,6 +501,97 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
     return {"rows": rows, "aggs": tuple(aggs)}
 
 
+def host_partial_tables(codes, measures, ops, n_groups, mask=None):
+    """Pure-NumPy :func:`partial_tables` — same pytree, host execution.
+
+    Exists for latency-aware routing: on a remote/tunneled device a single
+    dispatch+fetch costs tens of ms, so below a row threshold (see
+    ``models.query.host_kernel_rows``) the worker computes partials on the
+    host instead.  Bit-exactness is preserved without s64 overflow hazards:
+    int sums split into 16-bit limbs whose float64 ``bincount`` weights stay
+    exact integers (< 2^16 max limb x up to 2^37 rows < 2^53), recombined
+    mod 2^64.  NumPy is the reference semantics the device kernels are
+    tested against, so the two paths are interchangeable by construction.
+    """
+    import numpy as np
+
+    codes = np.asarray(codes)
+    valid = codes >= 0
+    if mask is not None:
+        valid = valid & np.asarray(mask)
+    safe = np.where(valid, codes, 0).astype(np.int64)
+    minlength = max(int(n_groups), 1)
+
+    def count_where(flags):
+        return np.bincount(
+            safe, weights=flags.astype(np.float64), minlength=minlength
+        ).astype(np.int64)
+
+    def exact_int_sum(values, present):
+        v = np.where(present, values.astype(np.int64), 0)
+        total = np.zeros(minlength, dtype=np.uint64)
+        for i in range(4):
+            if i < 3:  # unsigned 16-bit slices of the two's complement
+                limb = ((v >> np.int64(16 * i)) & np.int64(0xFFFF))
+            else:      # top limb keeps the sign via arithmetic shift
+                limb = v >> np.int64(48)
+            limb_sum = np.bincount(
+                safe, weights=limb.astype(np.float64), minlength=minlength
+            )
+            # float64 totals are exact integers (<2^16 x n rows << 2^53);
+            # recombine mod 2^64
+            total = total + (
+                limb_sum.astype(np.int64).astype(np.uint64)
+                << np.uint64(16 * i)
+            )
+        return total.astype(np.int64)
+
+    def null_mask(values):
+        if np.issubdtype(values.dtype, np.floating):
+            return np.isnan(values)
+        return np.zeros(values.shape, dtype=bool)
+
+    rows = count_where(valid)
+    aggs = []
+    for values, op in zip(measures, ops):
+        if op not in MERGEABLE_OPS:
+            raise ValueError(
+                f"op {op!r} has no mergeable partial; use the dedicated kernel"
+            )
+        values = np.asarray(values)
+        null = null_mask(values)
+        present = valid & ~null
+        if op in ("sum", "mean"):
+            if np.issubdtype(values.dtype, np.floating):
+                contrib = np.where(present, values, 0).astype(np.float64)
+                partial = {
+                    "sum": np.bincount(
+                        safe, weights=contrib, minlength=minlength
+                    )
+                }
+            else:
+                partial = {"sum": exact_int_sum(values, present)}
+            if op == "mean":
+                partial["count"] = count_where(present)
+            aggs.append(partial)
+        elif op == "count":
+            aggs.append({"count": count_where(present)})
+        elif op == "count_na":
+            aggs.append({"count": count_where(valid & null)})
+        elif op in ("min", "max"):
+            floating = np.issubdtype(values.dtype, np.floating)
+            if op == "min":
+                fill = np.inf if floating else np.iinfo(values.dtype).max
+                ext = np.full(minlength, fill, dtype=values.dtype)
+                np.minimum.at(ext, safe[present], values[present])
+            else:
+                fill = -np.inf if floating else np.iinfo(values.dtype).min
+                ext = np.full(minlength, fill, dtype=values.dtype)
+                np.maximum.at(ext, safe[present], values[present])
+            aggs.append({op: ext, "count": count_where(present)})
+    return {"rows": rows, "aggs": tuple(aggs)}
+
+
 def combine_partials(a, b):
     """Merge two partial-table pytrees (host- or device-side tree reduce)."""
     rows = a["rows"] + b["rows"]

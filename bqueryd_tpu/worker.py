@@ -495,6 +495,12 @@ class WorkerNode(WorkerBase):
             vals = np.ones(8, dtype=np.int64)
             partials = ops.partial_tables(codes, (vals,), ("sum",), 4, None)
             ops.finalize(partials, ("sum",))
+            # a dispatch-floor sample taken by a query while this compile
+            # held the backend is inflated; replace it with a clean one so
+            # host routing doesn't mis-route for the process lifetime
+            from bqueryd_tpu.models.query import device_dispatch_floor
+
+            device_dispatch_floor(remeasure=True)
             self.logger.info("kernel warmup done in %.1fs", time.time() - t0)
         except Exception:
             self.logger.exception("kernel warmup failed (continuing)")
@@ -546,12 +552,18 @@ class WorkerNode(WorkerBase):
         single shard -> single-device engine; other multi-shard shapes ->
         per-shard engine + host value-keyed merge.  Always returns ONE
         payload per CalcMessage."""
+        from bqueryd_tpu.models.query import host_kernel_rows
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
-        if MeshQueryExecutor.supports(query):
+        if MeshQueryExecutor.supports(query) and sum(
+            int(t.nrows) for t in tables
+        ) > host_kernel_rows():
             # single shards go through the mesh executor too: its alignment +
-            # HBM block caches make repeat queries one kernel dispatch
+            # HBM block caches make repeat queries one kernel dispatch.
+            # Queries at or below the host threshold fall through to the
+            # per-shard engine path, whose execute_local picks the host
+            # kernel (latency-aware routing, models.query.host_kernel_rows).
             self.mesh_executor.timer = timer
             return self.mesh_executor.execute(tables, query)
         if len(tables) == 1:

@@ -440,3 +440,105 @@ def test_nat_does_not_poison_datetime_stats(tmp_path):
     lo, hi = table.col_stats("t")
     assert lo == pd.Timestamp("2016-01-02").value
     assert hi == pd.Timestamp("2016-01-05").value
+
+
+# ---------------------------------------------------------------------------
+# host kernel (latency-aware routing)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["sum", "mean", "count", "count_na", "min", "max"])
+def test_host_partial_tables_matches_device(op):
+    """ops.host_partial_tables is the numpy twin of partial_tables: same
+    pytree, bit-exact ints, matching floats — the property that makes the
+    latency-aware host route interchangeable with the device path."""
+    import jax
+
+    rng = np.random.default_rng(41)
+    n, g = 30_000, 19
+    codes = rng.integers(-1, g, n).astype(np.int32)
+    mask = rng.random(n) < 0.85
+    if op in ("count_na",):
+        vals = (rng.random(n) * 100).astype(np.float64)
+        vals[rng.random(n) < 0.04] = np.nan
+    else:
+        vals = rng.integers(-(2**60), 2**60, n).astype(np.int64)
+    host = gb.host_partial_tables(codes, (vals,), (op,), g, mask=mask)
+    dev = jax.device_get(gb.partial_tables(codes, (vals,), (op,), g, mask=mask))
+    np.testing.assert_array_equal(host["rows"], dev["rows"])
+    for key in dev["aggs"][0]:
+        np.testing.assert_array_equal(
+            np.asarray(host["aggs"][0][key]), np.asarray(dev["aggs"][0][key]),
+            err_msg=f"op={op} partial={key}",
+        )
+
+
+def test_host_partial_tables_float_sum_close():
+    import jax
+
+    rng = np.random.default_rng(43)
+    n, g = 20_000, 7
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = (rng.random(n) * 100 - 50).astype(np.float32)
+    host = gb.host_partial_tables(codes, (vals,), ("mean",), g)
+    dev = jax.device_get(gb.partial_tables(codes, (vals,), ("mean",), g))
+    np.testing.assert_array_equal(
+        host["aggs"][0]["count"], dev["aggs"][0]["count"]
+    )
+    np.testing.assert_allclose(
+        host["aggs"][0]["sum"], dev["aggs"][0]["sum"], rtol=1e-5
+    )
+
+
+def test_host_kernel_rows_env_and_cap(monkeypatch):
+    from bqueryd_tpu.models import query as q
+
+    monkeypatch.setenv("BQUERYD_TPU_HOST_KERNEL_ROWS", "12345")
+    assert q.host_kernel_rows() == 12345
+    monkeypatch.setenv("BQUERYD_TPU_HOST_KERNEL_ROWS", "0")
+    assert q.host_kernel_rows() == 0
+    monkeypatch.delenv("BQUERYD_TPU_HOST_KERNEL_ROWS")
+    monkeypatch.setattr(q, "_measured_floor", 10.0)  # pathological link
+    assert q.host_kernel_rows() == q._HOST_ROUTE_CAP
+
+
+def test_engine_routes_small_queries_to_host(monkeypatch, tmp_path):
+    """Below the threshold execute_local must use the host kernel (no
+    device dispatch); above, the device path."""
+    import pandas as pd
+
+    from bqueryd_tpu import ops as ops_pkg
+    from bqueryd_tpu.models.query import GroupByQuery, QueryEngine
+
+    df = pd.DataFrame(
+        {
+            "g": np.arange(500, dtype=np.int64) % 5,
+            "v": np.arange(500, dtype=np.int64),
+        }
+    )
+    root = str(tmp_path / "t.bcolz")
+    ctable.fromdataframe(df, root)
+    table = ctable(root)
+    query = GroupByQuery(["g"], [["v", "sum", "s"]], [], aggregate=True)
+
+    calls = {"host": 0}
+    real = ops_pkg.host_partial_tables
+
+    def spy(*a, **k):
+        calls["host"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(ops_pkg, "host_partial_tables", spy)
+    monkeypatch.setenv("BQUERYD_TPU_HOST_KERNEL_ROWS", "1000")
+    payload_host = QueryEngine().execute_local(table, query)
+    assert calls["host"] == 1
+    monkeypatch.setenv("BQUERYD_TPU_HOST_KERNEL_ROWS", "0")
+    payload_dev = QueryEngine().execute_local(table, query)
+    assert calls["host"] == 1  # unchanged: device path taken
+    from bqueryd_tpu.parallel import hostmerge
+
+    df_h = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload_host]))
+    df_d = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload_dev]))
+    pd.testing.assert_frame_equal(
+        df_h.sort_values("g").reset_index(drop=True),
+        df_d.sort_values("g").reset_index(drop=True),
+    )
