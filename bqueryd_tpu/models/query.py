@@ -274,6 +274,24 @@ class QueryEngine:
 
     def __init__(self, timer=None):
         self.timer = timer
+        from bqueryd_tpu.utils.cache import BytesCappedCache
+
+        # per-(table, column) factorization cache: the host analogue of
+        # bquery's on-disk factorize cache (reference bqueryd/worker.py:291,
+        # auto_cache=True) — repeated queries on unchanged shards skip the
+        # hash factorize entirely.  Keyed on the shard's meta identity, so
+        # activation invalidates naturally.
+        self._factorize_cache = BytesCappedCache(
+            int(
+                os.environ.get(
+                    "BQUERYD_TPU_FACTORIZE_CACHE_BYTES", 256 * 1024**2
+                )
+            )
+        )
+
+    def clear_caches(self):
+        """Drop the factorize cache (memory-watchdog hook)."""
+        self._factorize_cache.clear()
 
     def _phase(self, name):
         import contextlib
@@ -292,10 +310,19 @@ class QueryEngine:
             codes = table.column_raw(col)
             values = np.asarray(table.dictionary(col), dtype=object)
             return codes, values
+        from bqueryd_tpu.storage.ctable import table_cache_key
+
+        cache_key = (table_cache_key(table), col)
+        hit = self._factorize_cache.get(cache_key)
+        if hit is not None:
+            return hit
         raw = table.column_raw(col)
         codes, uniques = ops.factorize(raw)
         if kind == "datetime":
             uniques = uniques.view("datetime64[ns]")
+        self._factorize_cache.put(
+            cache_key, (codes, uniques), nbytes=codes.nbytes + uniques.nbytes
+        )
         return codes, uniques
 
     # -- execution ---------------------------------------------------------
@@ -311,10 +338,14 @@ class QueryEngine:
         with self._phase("mask"):
             mask = ops.build_mask(table, query.where_terms)
             if query.expand_filter_column:
-                basket_raw = table.column_raw(query.expand_filter_column)
-                basket_codes, basket_uniques = ops.factorize(basket_raw)
+                # through the factorize cache: the basket column is usually
+                # the widest dictionary in the query
+                basket_codes, basket_uniques = self._key_codes(
+                    table, query.expand_filter_column
+                )
                 mask = ops.expand_mask_by_group(
-                    basket_codes, mask, n_groups=len(basket_uniques)
+                    np.asarray(basket_codes), mask,
+                    n_groups=len(basket_uniques),
                 )
 
         if not query.aggregate:

@@ -134,32 +134,9 @@ def _codes_dtype(n_groups):
     return np.dtype(np.int32)
 
 
-def _table_key(table):
-    """Cache identity of an on-disk table: path + metadata mtime + rows, so
-    reshard/activation (which rewrites meta.json) invalidates naturally.
-    Tables without a stat-able meta.json get a one-time random token pinned
-    to the instance (NOT id(): CPython reuses addresses after GC, which
-    would let a new table hit a dead table's cached blocks)."""
-    try:
-        st = os.stat(os.path.join(table.rootdir, "meta.json"))
-        # st_ino closes the same-mtime rewrite window: meta.json is written
-        # atomically (tempfile + rename), so every activation yields a fresh
-        # inode even when the timestamp granularity would hide the change
-        return (
-            os.path.realpath(table.rootdir),
-            st.st_ino,
-            st.st_mtime_ns,
-            int(table.nrows),
-        )
-    except (OSError, TypeError):
-        token = getattr(table, "_bqueryd_cache_token", None)
-        if token is None:
-            token = os.urandom(8).hex()
-            try:
-                table._bqueryd_cache_token = token
-            except AttributeError:
-                pass  # slotted/frozen table: unique token per call = no reuse
-        return ("unstable", token)
+# canonical table cache identity lives with the storage layer; kept under
+# the old private name for existing importers
+from bqueryd_tpu.storage.ctable import table_cache_key as _table_key  # noqa: E402,E501
 
 
 class MeshQueryExecutor:
@@ -174,6 +151,7 @@ class MeshQueryExecutor:
         self._mesh = mesh
         self.axis_name = axis_name
         self.timer = timer
+        self._align_engine = None
         from bqueryd_tpu.utils.cache import BytesCappedCache
 
         # host alignment cache: (tables_key, groupby_cols) ->
@@ -193,6 +171,18 @@ class MeshQueryExecutor:
         """Drop host alignment + HBM block caches (memory-watchdog hook)."""
         self._align_cache.clear()
         self._hbm_cache.clear()
+        if self._align_engine is not None:
+            self._align_engine.clear_caches()
+
+    def _engine(self):
+        """The engine used for alignment/key factorization — persistent so
+        its factorize cache survives across queries (a fresh engine per
+        execute() would re-factorize every alignment-cache miss)."""
+        if self._align_engine is None:
+            from bqueryd_tpu.models.query import QueryEngine
+
+            self._align_engine = QueryEngine()
+        return self._align_engine
 
     @property
     def mesh(self):
@@ -312,14 +302,13 @@ class MeshQueryExecutor:
     # -- execution ----------------------------------------------------------
     def execute(self, tables, query: GroupByQuery) -> ResultPayload:
         from bqueryd_tpu import ops
-        from bqueryd_tpu.models.query import QueryEngine
 
         if not self.supports(query):
             raise ValueError(
                 "MeshQueryExecutor handles mergeable aggregations only; "
                 "route distinct-count / raw-rows queries per shard"
             )
-        engine = QueryEngine()
+        engine = self._engine()
 
         with self._phase("prune"):
             tables = [
@@ -370,12 +359,13 @@ class MeshQueryExecutor:
                 for table in tables:
                     mask = ops.build_mask(table, query.where_terms)
                     if query.expand_filter_column:
-                        basket_raw = table.column_raw(
-                            query.expand_filter_column
+                        # through the engine's factorize cache
+                        bcodes, buniques = engine._key_codes(
+                            table, query.expand_filter_column
                         )
-                        bcodes, buniques = ops.factorize(basket_raw)
                         mask = ops.expand_mask_by_group(
-                            bcodes, mask, n_groups=len(buniques)
+                            np.asarray(bcodes), mask,
+                            n_groups=len(buniques),
                         )
                     masks.append(None if mask is None else np.asarray(mask))
             with self._phase("layout"):
@@ -394,21 +384,47 @@ class MeshQueryExecutor:
                 self._hbm_cache.put(codes_key, codes_d)
 
         with self._phase("layout"):
-            measures_d = []
-            for col in query.in_cols:
-                mkey = (tables_key, "col", col, n_dev)
-                arr = self._hbm_cache.get(mkey)
-                if arr is None:
-                    wire = _wire_dtype(tables, col) or _stored_dtype(
-                        tables, col
-                    )
-                    cols = [np.asarray(t.column_raw(col)) for t in tables]
-                    if wire is not None:
-                        cols = [c.astype(wire, copy=False) for c in cols]
-                    packed = self._pack(cols, n_dev, 0, dtype=wire)
-                    arr = _put(packed, sharding)
-                    self._hbm_cache.put(mkey, arr)
-                measures_d.append(arr)
+            def build_packed(col):
+                # decode (C++ chunk threads, GIL released) + narrow + pack
+                wire = _wire_dtype(tables, col) or _stored_dtype(tables, col)
+                cols = [np.asarray(t.column_raw(col)) for t in tables]
+                if wire is not None:
+                    cols = [c.astype(wire, copy=False) for c in cols]
+                return self._pack(cols, n_dev, 0, dtype=wire)
+
+            # cold path with several columns: overlap the NEXT column's
+            # decode+pack with the CURRENT column's host->device transfer
+            # (the two dominate cold latency and use disjoint resources)
+            missing = [
+                col
+                for col in query.in_cols
+                if self._hbm_cache.get((tables_key, "col", col, n_dev))
+                is None
+            ]
+            futures = {}
+            pool = None
+            if len(missing) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = ThreadPoolExecutor(max_workers=2)
+                futures = {c: pool.submit(build_packed, c) for c in missing}
+            try:
+                measures_d = []
+                for col in query.in_cols:
+                    mkey = (tables_key, "col", col, n_dev)
+                    arr = self._hbm_cache.get(mkey)
+                    if arr is None:
+                        packed = (
+                            futures[col].result()
+                            if col in futures
+                            else build_packed(col)
+                        )
+                        arr = _put(packed, sharding)
+                        self._hbm_cache.put(mkey, arr)
+                    measures_d.append(arr)
+            finally:
+                if pool is not None:
+                    pool.shutdown(wait=True)
 
         with self._phase("aggregate"):
             # returns host numpy partials; with packed fetch (default) the

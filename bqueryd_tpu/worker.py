@@ -432,6 +432,9 @@ class WorkerBase:
         executor = getattr(self, "_mesh_executor", None)
         if executor is not None:
             executor.clear_caches()
+        engine = getattr(self, "_engine", None)
+        if engine is not None:
+            engine.clear_caches()
         result_cache = getattr(self, "_result_cache", None)
         if result_cache:
             result_cache.clear()
