@@ -325,6 +325,26 @@ class QueryEngine:
         )
         return codes, uniques
 
+    def _basket_codes(self, table, col):
+        """Basket-expansion codes for ``expand_filter_column`` — cached like
+        :meth:`_key_codes` but with the basket semantics the engine always
+        shipped: the factorize runs over the PHYSICAL column, so dict-encoded
+        nulls (code -1) become one ordinary, selectable basket group (the
+        basket key is a plain value column, matching the reference's
+        ``is_in_ordered_subgroups`` which knows nothing about nulls)."""
+        from bqueryd_tpu import ops
+        from bqueryd_tpu.storage.ctable import table_cache_key
+
+        cache_key = (table_cache_key(table), col, "basket")
+        hit = self._factorize_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        codes, uniques = ops.factorize(np.asarray(table.column_raw(col)))
+        self._factorize_cache.put(
+            cache_key, (codes, uniques), nbytes=codes.nbytes + uniques.nbytes
+        )
+        return codes, uniques
+
     # -- execution ---------------------------------------------------------
     def execute_local(self, table, query: GroupByQuery) -> ResultPayload:
         from bqueryd_tpu import ops
@@ -338,14 +358,11 @@ class QueryEngine:
         with self._phase("mask"):
             mask = ops.build_mask(table, query.where_terms)
             if query.expand_filter_column:
-                # through the factorize cache: the basket column is usually
-                # the widest dictionary in the query
-                basket_codes, basket_uniques = self._key_codes(
+                basket_codes, basket_uniques = self._basket_codes(
                     table, query.expand_filter_column
                 )
                 mask = ops.expand_mask_by_group(
-                    np.asarray(basket_codes), mask,
-                    n_groups=len(basket_uniques),
+                    basket_codes, mask, n_groups=len(basket_uniques)
                 )
 
         if not query.aggregate:

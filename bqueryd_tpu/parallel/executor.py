@@ -359,13 +359,12 @@ class MeshQueryExecutor:
                 for table in tables:
                     mask = ops.build_mask(table, query.where_terms)
                     if query.expand_filter_column:
-                        # through the engine's factorize cache
-                        bcodes, buniques = engine._key_codes(
+                        # cached, with nulls-are-a-basket semantics
+                        bcodes, buniques = engine._basket_codes(
                             table, query.expand_filter_column
                         )
                         mask = ops.expand_mask_by_group(
-                            np.asarray(bcodes), mask,
-                            n_groups=len(buniques),
+                            bcodes, mask, n_groups=len(buniques)
                         )
                     masks.append(None if mask is None else np.asarray(mask))
             with self._phase("layout"):
@@ -403,22 +402,33 @@ class MeshQueryExecutor:
             ]
             futures = {}
             pool = None
+            missing_iter = iter(missing)
+
+            def submit_next():
+                for c in missing_iter:
+                    futures[c] = pool.submit(build_packed, c)
+                    return
+
             if len(missing) > 1:
                 from concurrent.futures import ThreadPoolExecutor
 
-                pool = ThreadPoolExecutor(max_workers=2)
-                futures = {c: pool.submit(build_packed, c) for c in missing}
+                pool = ThreadPoolExecutor(max_workers=1)
+                # depth-2 pipeline: one build in flight ahead of the put
+                # loop, the next submitted as each completes — peak host
+                # residency stays ~2 packed columns however many are missing
+                submit_next()
+                submit_next()
             try:
                 measures_d = []
                 for col in query.in_cols:
                     mkey = (tables_key, "col", col, n_dev)
                     arr = self._hbm_cache.get(mkey)
                     if arr is None:
-                        packed = (
-                            futures[col].result()
-                            if col in futures
-                            else build_packed(col)
-                        )
+                        if col in futures:
+                            packed = futures.pop(col).result()
+                            submit_next()
+                        else:
+                            packed = build_packed(col)
                         arr = _put(packed, sharding)
                         self._hbm_cache.put(mkey, arr)
                     measures_d.append(arr)

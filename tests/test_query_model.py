@@ -231,3 +231,38 @@ def test_merge_empty_payloads():
 def test_agg_list_normalization():
     q = GroupByQuery(["k"], ["v", ["w", "mean"], ["x", "sum", "y"]])
     assert q.agg_list == [["v", "sum", "v"], ["w", "mean", "w"], ["x", "sum", "y"]]
+
+
+def test_basket_expansion_null_baskets_are_one_group(tmp_path):
+    """Dict-encoded basket columns with nulls: the null rows form ONE
+    ordinary basket (the factorize runs over the physical codes, so -1 is
+    a value like any other — the engine's long-standing semantics, kept
+    when the factorize cache was introduced)."""
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    df = pd.DataFrame(
+        {
+            "g": [1, 1, 2, 2, 1, 2],
+            "v": [10, 20, 30, 40, 50, 60],
+            "basket": ["a", None, None, "b", "a", None],
+            "d": [0.0, 99.0, 0.0, 0.0, 0.0, 0.0],
+        }
+    )
+    root = str(tmp_path / "nb.bcolz")
+    CT.fromdataframe(df, root)
+    query = GroupByQuery(
+        ["g"],
+        [["v", "sum", "s"]],
+        [["d", ">", 50.0]],
+        aggregate=True,
+        expand_filter_column="basket",
+    )
+    payload = QueryEngine().execute_local(CT(root), query)
+    from bqueryd_tpu.parallel import hostmerge
+
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload]))
+    # the matching row (d=99) has a NULL basket -> every null-basket row is
+    # selected: rows v=20 (g=1), v=30 and v=60 (g=2)
+    got = got.sort_values("g").reset_index(drop=True)
+    assert got["g"].tolist() == [1, 2]
+    assert got["s"].tolist() == [20, 90]
