@@ -439,29 +439,32 @@ def open_ctable(rootdir, mode="r", **kw):
     return ctable(rootdir, mode=mode, **kw)
 
 
+def rootdir_cache_key(rootdir):
+    """Stat-based identity of a table rootdir, or None when meta.json is
+    not stat-able.  st_ino closes the same-mtime rewrite window: meta.json
+    is written atomically (tempfile + rename), so every activation yields a
+    fresh inode even when the timestamp granularity would hide the change."""
+    try:
+        st = os.stat(os.path.join(rootdir, "meta.json"))
+    except (OSError, TypeError):
+        return None
+    return (os.path.realpath(rootdir), st.st_ino, st.st_mtime_ns)
+
+
 def table_cache_key(table):
     """Cache identity of an on-disk table: path + metadata mtime + rows, so
     reshard/activation (which rewrites meta.json) invalidates naturally.
     Tables without a stat-able meta.json get a one-time random token pinned
     to the instance (NOT id(): CPython reuses addresses after GC, which
     would let a new table hit a dead table's cached blocks)."""
-    try:
-        st = os.stat(os.path.join(table.rootdir, "meta.json"))
-        # st_ino closes the same-mtime rewrite window: meta.json is written
-        # atomically (tempfile + rename), so every activation yields a fresh
-        # inode even when the timestamp granularity would hide the change
-        return (
-            os.path.realpath(table.rootdir),
-            st.st_ino,
-            st.st_mtime_ns,
-            int(table.nrows),
-        )
-    except (OSError, TypeError):
-        token = getattr(table, "_bqueryd_cache_token", None)
-        if token is None:
-            token = os.urandom(8).hex()
-            try:
-                table._bqueryd_cache_token = token
-            except AttributeError:
-                pass  # slotted/frozen table: unique token per call = no reuse
-        return ("unstable", token)
+    key = rootdir_cache_key(getattr(table, "rootdir", None))
+    if key is not None:
+        return key + (int(table.nrows),)
+    token = getattr(table, "_bqueryd_cache_token", None)
+    if token is None:
+        token = os.urandom(8).hex()
+        try:
+            table._bqueryd_cache_token = token
+        except AttributeError:
+            pass  # slotted/frozen table: unique token per call = no reuse
+    return ("unstable", token)

@@ -435,6 +435,9 @@ class WorkerBase:
         engine = getattr(self, "_engine", None)
         if engine is not None:
             engine.clear_caches()
+        # dict-column instances pin their value dictionaries — not "light"
+        # under memory pressure
+        getattr(self, "_table_cache", {}).clear()
         result_cache = getattr(self, "_result_cache", None)
         if result_cache:
             result_cache.clear()
@@ -458,6 +461,7 @@ class WorkerNode(WorkerBase):
         self._engine = None
         self._mesh_executor = None
         self._result_cache = None
+        self._table_cache = {}
         self._warmup_thread = None
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
@@ -580,6 +584,26 @@ class WorkerNode(WorkerBase):
 
         return ResultPayload(merged)
 
+    def _open_table(self, rootdir):
+        """Table instances cached by meta identity: re-opening per query
+        costs a meta.json parse per shard; activation (fresh inode/mtime)
+        misses naturally.  Instances are read-only and light — column bytes
+        live in the storage module's global cache, not per instance."""
+        from bqueryd_tpu.storage import ctable
+        from bqueryd_tpu.storage.ctable import rootdir_cache_key
+
+        key = rootdir_cache_key(rootdir)
+        if key is not None:
+            hit = self._table_cache.get(key)
+            if hit is not None:
+                return hit
+        table = ctable(rootdir, mode="r", auto_cache=True)
+        if key is not None:
+            if len(self._table_cache) > 512:
+                self._table_cache.clear()
+            self._table_cache[key] = table
+        return table
+
     def handle_work(self, msg):
         if msg.isa("execute_code"):
             return self.execute_code(msg)
@@ -587,7 +611,6 @@ class WorkerNode(WorkerBase):
             return super().handle_work(msg)
 
         from bqueryd_tpu.models.query import GroupByQuery
-        from bqueryd_tpu.storage import ctable
 
         timer = PhaseTimer()
         args, kwargs = msg.get_args_kwargs()
@@ -607,7 +630,7 @@ class WorkerNode(WorkerBase):
                 rootdir = os.path.join(self.data_dir, name)
                 if not os.path.exists(rootdir):
                     raise ValueError(f"Path {rootdir} does not exist")
-                tables.append(ctable(rootdir, mode="r", auto_cache=True))
+                tables.append(self._open_table(rootdir))
         cache = self.result_cache
         cache_key = None
         data = None

@@ -376,3 +376,26 @@ def test_cli_import(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(ctable(dst).column("a")), frame["a"]
     )
+
+
+def test_read_carray_datetime_and_float(tmp_path):
+    """datetime64[ns] and float32 columns round-trip through the Blosc
+    decode (dtype strings as bcolz stores them, e.g. '<M8[ns]')."""
+    stamps = np.array(
+        ["2016-01-01T00:00:00", "2016-01-02T12:34:56"] * 700,
+        dtype="datetime64[ns]",
+    )
+    write_bcolz_v1_carray(str(tmp_path / "dt"), stamps.view(np.int64))
+    # dtype metadata says datetime: rewrite storage meta accordingly
+    storage = json.load(open(tmp_path / "dt" / "meta" / "storage"))
+    storage["dtype"] = "<M8[ns]"
+    json.dump(storage, open(tmp_path / "dt" / "meta" / "storage", "w"))
+    got = bcolz_v1.read_carray(str(tmp_path / "dt"))
+    assert got.dtype == np.dtype("<M8[ns]")
+    np.testing.assert_array_equal(got, stamps)
+
+    floats = (np.random.default_rng(2).random(1500) * 7).astype(np.float32)
+    write_bcolz_v1_carray(str(tmp_path / "f"), floats, chunklen=512)
+    np.testing.assert_array_equal(
+        bcolz_v1.read_carray(str(tmp_path / "f")), floats
+    )
