@@ -572,20 +572,44 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack):
     return jax.jit(fn), spec
 
 
+#: set when the packed program failed to build/run on this backend (seen
+#: nowhere yet; guards against a backend rejecting the byte bitcasts) — all
+#: later queries go straight to the per-leaf fetch
+_packed_fetch_broken = False
+
+
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d):
     """Run the mesh program and return the merged partials pytree ON HOST
     (numpy leaves) — fetching one packed buffer when packing is enabled."""
+    global _packed_fetch_broken
     import jax
 
-    pack = packed_fetch_enabled()
+    pack = packed_fetch_enabled() and not _packed_fetch_broken
     in_dtypes = (str(codes_d.dtype),) + tuple(str(m.dtype) for m in measures_d)
-    program, spec = _mesh_program(
-        mesh, axis, tuple(agg_ops), int(n_groups), in_dtypes,
-        int(codes_d.shape[1]), pack,
-    )
-    out = program(codes_d, *measures_d)
-    if not pack:
-        return jax.device_get(out)
-    flat = np.asarray(jax.device_get(out))
-    leaves = _unpack_host(flat, spec["leaves"])
-    return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
+
+    def run(pack_flag):
+        return _mesh_program(
+            mesh, axis, tuple(agg_ops), int(n_groups), in_dtypes,
+            int(codes_d.shape[1]), pack_flag,
+        )
+
+    if pack:
+        try:
+            program, spec = run(True)
+            out = program(codes_d, *measures_d)
+            flat = np.asarray(jax.device_get(out))
+        except Exception:
+            # packed compile/run failure must never fail the query: fall
+            # back to per-leaf fetch for the process lifetime
+            _packed_fetch_broken = True
+            import logging
+
+            logging.getLogger("bqueryd_tpu").exception(
+                "packed fetch unavailable on this backend; using per-leaf "
+                "device_get"
+            )
+        else:
+            leaves = _unpack_host(flat, spec["leaves"])
+            return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
+    program, _spec = run(False)
+    return jax.device_get(program(codes_d, *measures_d))
