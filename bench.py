@@ -72,6 +72,10 @@ CONFIGS = [
 ]
 
 HEADLINE = "sharded"
+#: set by ensure_backend when the configured backend was unreachable and the
+#: run fell back to CPU (recorded in the output so a fallback run is never
+#: mistaken for a TPU measurement)
+BACKEND_FELL_BACK = False
 # Registration + first-call deadlines sized for tunneled-TPU backend
 # bring-up, which was measured at >9.5 minutes on this box (round-2 verdict).
 # Registration itself is no longer gated on warmup, but keep both generous.
@@ -413,6 +417,8 @@ def ensure_backend():
         ok = False
     if ok:
         return
+    global BACKEND_FELL_BACK
+    BACKEND_FELL_BACK = True
     print(
         "[bench] default backend unavailable; falling back to CPU "
         "(numbers will record backend=cpu)",
@@ -623,6 +629,7 @@ def main():
             "rows": ROWS,
             "shards": SHARDS,
             "backend": jax.default_backend(),
+            "backend_fell_back": BACKEND_FELL_BACK,
             "n_devices": len(jax.devices()),
             "device_roundtrip_floor_s": (
                 None if floor_s is None else round(floor_s, 4)
@@ -654,6 +661,7 @@ def main():
                     "vs_baseline": head["speedup"],
                     "detail": {
                         "backend": full_detail["backend"],
+                        "backend_fell_back": BACKEND_FELL_BACK,
                         "n_devices": full_detail["n_devices"],
                         "rows": ROWS,
                         "shards": SHARDS,
