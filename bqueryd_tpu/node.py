@@ -80,8 +80,13 @@ def main(argv=None):
     if args.role == "import":
         if not args.address or not args.dest:
             parser.error("import needs <src.bcolz> <dst.bcolz>")
-        from bqueryd_tpu.storage.bcolz_v1 import import_ctable
+        from bqueryd_tpu.storage.bcolz_v1 import import_ctable, is_ctable_dir
 
+        if not is_ctable_dir(args.address):
+            parser.error(
+                f"{args.address} is not a bcolz v1 ctable rootdir "
+                "(no carray column subdirectories found)"
+            )
         rows = import_ctable(args.address, args.dest)
         print(f"imported {rows} rows: {args.address} -> {args.dest}")
     elif args.role == "controller":
