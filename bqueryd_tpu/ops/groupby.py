@@ -16,8 +16,13 @@ Design:
   accumulation is exact; per-block tables are then recombined in uint64
   (mod-2^64 arithmetic == two's complement) — bit-exact for the full int64
   range.  Counts ride along as a row of ones in the same matmul.  min/max,
-  float64 measures, and cardinalities above ``matmul_groups_limit()`` fall
-  back to the scatter path;
+  float64 measures, and cardinalities above ``matmul_groups_limit()`` use
+  the scatter path: exact 16-bit-limb int32 scatters over 64Ki row blocks
+  (mod-2^32 wrap recovered by a uint32 bitcast), switching to a sort +
+  prefix-diff reduction at extreme cardinality where the blocked table
+  would outgrow ``_MAX_BLOCK_SEGMENTS`` — never the emulated-s64 scatter.
+  A pure-NumPy twin (:func:`host_partial_tables`) serves latency-aware
+  host routing for small inputs;
 * results are produced as **partial tables** (pytrees of fixed-width arrays,
   e.g. mean = {sum, count}) that are closed under elementwise merge: merging
   shard partials is ``combine_partials`` on host/device or ``psum_partials``
