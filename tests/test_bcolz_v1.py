@@ -399,3 +399,50 @@ def test_read_carray_datetime_and_float(tmp_path):
     np.testing.assert_array_equal(
         bcolz_v1.read_carray(str(tmp_path / "f")), floats
     )
+
+
+@pytest.mark.parametrize("decoder", ["py", "native"])
+def test_chunk_decoders_survive_corrupt_input(decoder):
+    """The decoders face untrusted legacy bytes: random garbage and
+    bit-flipped valid chunks must fail cleanly (ValueError / 0-return),
+    never crash or return oversized output (seeded, bounded)."""
+    if decoder == "native" and not native.blosc_available():
+        pytest.skip("native lib without blosc symbols")
+    rng = np.random.default_rng(99)
+    values = rng.integers(0, 1000, 2048).astype(np.int64)
+    valid = build_blosc_chunk(values.tobytes(), 8)
+
+    def attempt(buf):
+        if decoder == "py":
+            try:
+                out = bcolz_v1._blosc_decode_chunk_py(buf)
+            except bcolz_v1._DECODE_ERRORS:
+                return None
+            return out
+        try:
+            nbytes, _t, _f = native.blosc_info(bytes(buf))
+        except ValueError:
+            return None
+        if not 0 <= nbytes <= (64 << 20):
+            return None
+        try:
+            return native.blosc_decode(bytes(buf), nbytes)
+        except ValueError:
+            return None
+
+    # pure garbage
+    for n in (0, 1, 15, 16, 17, 64, 300):
+        for _ in range(12):
+            attempt(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+    # bit-flipped valid chunks: either clean failure or SOME bytes back
+    arr = np.frombuffer(valid, dtype=np.uint8).copy()
+    for _ in range(150):
+        mutated = arr.copy()
+        for _ in range(int(rng.integers(1, 4))):
+            mutated[rng.integers(0, len(mutated))] ^= 1 << int(
+                rng.integers(0, 8)
+            )
+        attempt(mutated.tobytes())
+    # truncations
+    for cut in rng.integers(0, len(valid), 25):
+        attempt(valid[: int(cut)])
