@@ -52,7 +52,13 @@ def _accum_dtype(dtype):
     dtype = jnp.dtype(dtype)
     if jnp.issubdtype(dtype, jnp.integer) or jnp.issubdtype(dtype, jnp.bool_):
         return jnp.int64
-    return dtype  # float32 stays float32, float64 stays float64
+    if jnp.issubdtype(dtype, jnp.floating) and jax.config.jax_enable_x64:
+        # f32 scatter sums accumulate in f64: the MXU path represents f32
+        # losslessly via its 3-limb split, and the scatter path must match
+        # that accuracy (a plain f32 segment_sum drifts ~1e-4 at 1M-row
+        # groups, outside the bench's float gate)
+        return jnp.float64
+    return dtype
 
 
 def _null_mask(values):
@@ -73,25 +79,27 @@ _SUM_BLOCK = 65536
 _MAX_BLOCK_SEGMENTS = 1 << 25
 
 
-def _sorted_segment_sum(values, safe, n_groups):
-    """Exact per-group int64 sums at extreme cardinality: sort rows by group
-    code, prefix-sum the sorted values, and difference the prefix at group
-    boundaries.  One O(n log n) device sort + cheap elementwise s64 adds —
-    never an s64 scatter, and no ``blocks x groups`` table, so cost is
-    independent of ``n_groups`` (the blocked path's failure mode).  Wrapping
-    (mod 2^64) prefix sums difference back exactly, so the result is
-    bit-exact for the full int64 range."""
+def _sorted_segment_sum(values, safe, n_groups, acc_dtype=jnp.int64):
+    """Per-group sums without a wide scatter: sort rows by group code,
+    prefix-sum the sorted values in ``acc_dtype``, and difference the prefix
+    at group boundaries.  One O(n log n) device sort + cheap elementwise
+    wide adds (only the SCATTER is expensive in emulated 64-bit arithmetic),
+    and no ``blocks x groups`` table, so cost is independent of ``n_groups``.
+    For int64 the wrapping (mod 2^64) prefix sums difference back exactly —
+    bit-exact for the full range; for float64 accumulation the prefix-diff
+    matches direct summation to ~1 ulp of the running prefix."""
     codes_s, order = lax.sort(
         (safe, jnp.arange(safe.shape[0], dtype=jnp.int32)), num_keys=1
     )
-    v_s = values[order].astype(jnp.int64)
+    v_s = values[order].astype(acc_dtype)
     prefix = jnp.cumsum(v_s)
     # one past the last row of each group (== prefix index of its total)
     ends = jnp.searchsorted(
         codes_s, jnp.arange(n_groups, dtype=codes_s.dtype), side="right"
     )
-    bounds = jnp.concatenate([jnp.zeros(1, jnp.int64), prefix])[ends]
-    return jnp.diff(jnp.concatenate([jnp.zeros(1, jnp.int64), bounds]))
+    zero = jnp.zeros(1, acc_dtype)
+    bounds = jnp.concatenate([zero, prefix])[ends]
+    return jnp.diff(jnp.concatenate([zero, bounds]))
 
 
 def _int64_segment_sum(values, valid, safe, n_groups):
@@ -180,6 +188,16 @@ def _matmul_profitable(measures, ops, n, n_groups):
     """MXU path only when within budget AND some sum/count actually rides the
     matmul (min/max and float64 sums scatter regardless, so a query made only
     of those gains nothing from building the one-hot)."""
+    if (
+        jax.default_backend() == "cpu"
+        and os.environ.get("BQUERYD_TPU_FORCE_MATMUL") != "1"
+    ):
+        # the one-hot bf16 contraction exists for the systolic array; on a
+        # CPU backend it emulates ~7x slower than the int32 scatter
+        # (measured at 10M rows x 9 groups).  BQUERYD_TPU_FORCE_MATMUL=1
+        # overrides (the test suite pins it to keep MXU-path coverage on
+        # the CPU test backend); the groups knob stays purely value-based.
+        return False
     if not (0 < n_groups <= matmul_groups_limit()):
         return False
     if n * n_groups > _matmul_cells_limit():
@@ -484,7 +502,22 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
                 contrib = jnp.where(present, values, 0).astype(
                     _accum_dtype(values.dtype)
                 )
-                partial = {"sum": seg_sum(contrib)}
+                if (
+                    contrib.dtype == jnp.float64
+                    and jax.default_backend() != "cpu"
+                ):
+                    # no native f64 on TPU: an emulated-f64 scatter is the
+                    # wide-scatter cost this module exists to avoid; the
+                    # sort+prefix-diff reduction uses only cheap elementwise
+                    # wide adds (backend read at trace time, outside data
+                    # flow)
+                    partial = {
+                        "sum": _sorted_segment_sum(
+                            contrib, safe, n_groups, acc_dtype=jnp.float64
+                        )
+                    }
+                else:
+                    partial = {"sum": seg_sum(contrib)}
             else:
                 partial = {
                     "sum": _int64_segment_sum(values, present, safe, n_groups)

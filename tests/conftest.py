@@ -65,3 +65,8 @@ def mem_store_url():
 # host and device paths run-to-run.  Pin tests to the device path; dedicated
 # host-kernel tests opt in explicitly.
 os.environ.setdefault("BQUERYD_TPU_HOST_KERNEL_ROWS", "0")
+
+# The MXU one-hot matmul route auto-disables on CPU backends (it emulates
+# far slower than the scatter there); pin it ON for the suite so the CPU
+# test backend keeps exercising the MXU kernel paths (limb plans, Pallas).
+os.environ.setdefault("BQUERYD_TPU_FORCE_MATMUL", "1")

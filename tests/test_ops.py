@@ -542,3 +542,17 @@ def test_engine_routes_small_queries_to_host(monkeypatch, tmp_path):
         df_h.sort_values("g").reset_index(drop=True),
         df_d.sort_values("g").reset_index(drop=True),
     )
+
+
+def test_matmul_route_auto_disables_on_cpu_backend(monkeypatch):
+    """Without the force flag, a CPU backend must take the scatter path
+    (the bf16 one-hot matmul emulates ~7x slower there)."""
+    m = _groupby_module()
+    monkeypatch.delenv("BQUERYD_TPU_FORCE_MATMUL", raising=False)
+    assert not m._matmul_profitable(
+        (np.ones(64, dtype=np.int64),), ("sum",), 64, 8
+    )
+    monkeypatch.setenv("BQUERYD_TPU_FORCE_MATMUL", "1")
+    assert m._matmul_profitable(
+        (np.ones(64, dtype=np.int64),), ("sum",), 64, 8
+    )
