@@ -196,3 +196,96 @@ def test_ticket_error_released_to_waiting_client(controller):
     assert reply["msg_type"] == "error"
     assert "bucket on fire" in reply["payload"]
     assert "ticket_t9" not in controller.rpc_segments
+
+
+def test_concurrent_clients_all_get_correct_results(tmp_path, mem_store_url):
+    """Four client threads interleaving two query shapes against a
+    two-worker cluster: every reply must be the bit-correct answer for ITS
+    query (exercises the affinity queues, busy/done flow control, and sink
+    bookkeeping under real concurrency)."""
+    import logging
+    import threading
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+    from tests.conftest import wait_until
+
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame(
+        {
+            "g": rng.integers(0, 9, 20_000).astype(np.int64),
+            "h": rng.integers(0, 4, 20_000).astype(np.int64),
+            "v": rng.integers(-(10**10), 10**10, 20_000).astype(np.int64),
+            "f": rng.random(20_000).astype(np.float32) * 50,
+        }
+    )
+    for i in range(4):
+        ctable.fromdataframe(
+            df.iloc[i::4], str(tmp_path / f"s{i}.bcolzs")
+        )
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path), heartbeat_interval=0.2,
+    )
+    workers = [
+        WorkerNode(
+            coordination_url=mem_store_url, data_dir=str(tmp_path),
+            loglevel=logging.WARNING, restart_check=False,
+            heartbeat_interval=0.2, poll_timeout=0.1,
+        )
+        for _ in range(2)
+    ]
+    nodes = [controller] + workers
+    threads = [threading.Thread(target=n.go, daemon=True) for n in nodes]
+    for t in threads:
+        t.start()
+    try:
+        wait_until(
+            lambda: len(controller.files_map) >= 4, desc="shards registered"
+        )
+        shards = [f"s{i}.bcolzs" for i in range(4)]
+        exp_sum = df.groupby("g")["v"].sum().sort_index().tolist()
+        exp_multi = (
+            df[df.f > 25].groupby(["g", "h"])["v"].sum().sort_index().tolist()
+        )
+        errors = []
+
+        def client(ci):
+            try:
+                rpc = RPC(
+                    coordination_url=mem_store_url, timeout=60,
+                    loglevel=logging.WARNING,
+                )
+                for q in range(8):
+                    if (ci + q) % 2 == 0:
+                        got = rpc.groupby(
+                            shards, ["g"], [["v", "sum", "s"]], []
+                        ).sort_values("g")
+                        assert got["s"].tolist() == exp_sum
+                    else:
+                        got = rpc.groupby(
+                            shards, ["g", "h"], [["v", "sum", "s"]],
+                            [["f", ">", 25.0]],
+                        ).sort_values(["g", "h"])
+                        assert got["s"].tolist() == exp_multi
+            except Exception as exc:  # surfaced below with client id
+                errors.append(f"client {ci}: {exc!r}")
+
+        cts = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in cts:
+            t.start()
+        for t in cts:
+            t.join(timeout=120)
+        assert not errors, errors
+    finally:
+        for n in nodes:
+            n.running = False
+        for t in threads:
+            t.join(timeout=5)
