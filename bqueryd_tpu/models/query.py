@@ -243,6 +243,11 @@ _HOST_NS_PER_ROW = 20e-9
 #: so the latency-derived threshold below is the rule on every backend.)
 _HOST_ROUTE_CAP = 4_000_000
 
+#: multi-key composite spaces at most this large aggregate directly over the
+#: full (K1*...*Kn)-slot space instead of paying an O(n) compaction pass;
+#: empty combos are dropped at collect, so only kernel minlength grows
+_DENSE_COMBO_CAP = 1 << 16
+
 
 def host_kernel_rows():
     """Row threshold below which mergeable aggregations run on the HOST
@@ -376,12 +381,47 @@ class QueryEngine:
             code_arrays = [np.asarray(c) for c, _ in per_key]
             key_values = [v for _, v in per_key]
             cards = [len(v) for v in key_values]
+            # Null keys (code -1, dict-encoded missing values) stay -1 in the
+            # dense codes: every kernel treats negative codes as invalid, so
+            # null-key rows vanish from the aggregation (pandas dropna
+            # semantics, same convention as the mesh executor's alignment).
+            # Re-factorizing them into a real group would make ``collect``
+            # index key_values[-1] — a wrapped, wrong key.
             if len(code_arrays) == 1:
-                packed = code_arrays[0]
+                # _key_codes already produced dense first-seen codes into
+                # key_values, so a second factorize is the identity map —
+                # skipping it saves ~12ms/M rows, the whole host-route budget
+                dense = code_arrays[0]
+                combos = np.arange(cards[0], dtype=np.int64)
+                n_groups = max(cards[0], 1)
             else:
                 packed = ops.pack_codes(code_arrays, cards)
-            dense, combos = ops.factorize(packed)
-            n_groups = max(len(combos), 1)
+                total_card = ops.total_cardinality(cards)
+                if total_card <= _DENSE_COMBO_CAP:
+                    # composite space small enough to aggregate over
+                    # directly; empty combos drop at collect via rows == 0
+                    dense = packed
+                    combos = np.arange(total_card, dtype=np.int64)
+                    n_groups = max(total_card, 1)
+                else:
+                    # compact the sparse composite space with the O(n) hash
+                    # factorizer, then evict the null composite (-1) from
+                    # the group dictionary so it stays invalid downstream.
+                    # (Unsorted first-seen combos are fine here: hostmerge
+                    # aligns payloads by key VALUES, unlike the mesh
+                    # executor's alignment which needs a sorted global
+                    # order.)
+                    dense, combos = ops.factorize(packed)
+                    null_at = np.flatnonzero(combos == -1)
+                    if len(null_at):
+                        j = int(null_at[0])
+                        remap = np.empty(len(combos), dtype=np.int64)
+                        remap[:j] = np.arange(j)
+                        remap[j] = -1
+                        remap[j + 1:] = np.arange(j, len(combos) - 1)
+                        dense = remap[dense]
+                        combos = np.delete(combos, j)
+                    n_groups = max(len(combos), 1)
 
         with self._phase("aggregate"):
             mask_arr = None if mask is None else np.asarray(mask)

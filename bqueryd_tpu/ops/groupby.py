@@ -556,17 +556,38 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
     codes = np.asarray(codes)
     valid = codes >= 0
     if mask is not None:
-        valid = valid & np.asarray(mask)
-    safe = np.where(valid, codes, 0).astype(np.int64)
+        valid = valid & np.asarray(mask, dtype=bool)
+    # the common case — no null keys, no filter — skips every np.where
+    # masking pass and takes integer (unweighted) bincounts throughout
+    all_valid = bool(valid.all())
+    safe = (
+        codes.astype(np.int64)
+        if all_valid
+        else np.where(valid, codes, 0).astype(np.int64)
+    )
     minlength = max(int(n_groups), 1)
 
     def count_where(flags):
+        if flags is None:  # all rows count
+            return np.bincount(safe, minlength=minlength).astype(np.int64)
         return np.bincount(
             safe, weights=flags.astype(np.float64), minlength=minlength
         ).astype(np.int64)
 
     def exact_int_sum(values, present):
-        v = np.where(present, values.astype(np.int64), 0)
+        v = values.astype(np.int64, copy=False)
+        if present is not None:
+            v = np.where(present, v, 0)
+        if len(v):
+            # one float64-weighted bincount is exact when every partial sum
+            # stays below 2^53: |any partial| <= n rows x max|value|
+            bound = max(abs(int(v.min())), abs(int(v.max())))
+            if bound * len(v) < 2**53:
+                return np.bincount(
+                    safe, weights=v.astype(np.float64), minlength=minlength
+                ).astype(np.int64)
+        # full-range fallback: 16-bit limbs keep the weighted bincounts
+        # exact (< 2^16 max limb x up to 2^37 rows < 2^53) at 4x the cost
         total = np.zeros(minlength, dtype=np.uint64)
         for i in range(4):
             if i < 3:  # unsigned 16-bit slices of the two's complement
@@ -576,8 +597,7 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
             limb_sum = np.bincount(
                 safe, weights=limb.astype(np.float64), minlength=minlength
             )
-            # float64 totals are exact integers (<2^16 x n rows << 2^53);
-            # recombine mod 2^64
+            # float64 totals are exact integers; recombine mod 2^64
             total = total + (
                 limb_sum.astype(np.int64).astype(np.uint64)
                 << np.uint64(16 * i)
@@ -589,7 +609,7 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
             return np.isnan(values)
         return np.zeros(values.shape, dtype=bool)
 
-    rows = count_where(valid)
+    rows = count_where(None if all_valid else valid)
     aggs = []
     for values, op in zip(measures, ops):
         if op not in MERGEABLE_OPS:
@@ -598,10 +618,16 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
             )
         values = np.asarray(values)
         null = null_mask(values)
-        present = valid & ~null
+        has_null = null.any() if np.issubdtype(
+            values.dtype, np.floating
+        ) else False
+        # present=None means "every row contributes" — the fast paths above
+        present = None if (all_valid and not has_null) else (valid & ~null)
         if op in ("sum", "mean"):
             if np.issubdtype(values.dtype, np.floating):
-                contrib = np.where(present, values, 0).astype(np.float64)
+                contrib = (
+                    values if present is None else np.where(present, values, 0)
+                ).astype(np.float64)
                 partial = {
                     "sum": np.bincount(
                         safe, weights=contrib, minlength=minlength
@@ -615,17 +641,23 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
         elif op == "count":
             aggs.append({"count": count_where(present)})
         elif op == "count_na":
-            aggs.append({"count": count_where(valid & null)})
+            na = (
+                np.zeros(minlength, dtype=np.int64)
+                if not has_null
+                else count_where(valid & null)
+            )
+            aggs.append({"count": na})
         elif op in ("min", "max"):
             floating = np.issubdtype(values.dtype, np.floating)
+            sel = slice(None) if present is None else present
             if op == "min":
                 fill = np.inf if floating else np.iinfo(values.dtype).max
                 ext = np.full(minlength, fill, dtype=values.dtype)
-                np.minimum.at(ext, safe[present], values[present])
+                np.minimum.at(ext, safe[sel], values[sel])
             else:
                 fill = -np.inf if floating else np.iinfo(values.dtype).min
                 ext = np.full(minlength, fill, dtype=values.dtype)
-                np.maximum.at(ext, safe[present], values[present])
+                np.maximum.at(ext, safe[sel], values[sel])
             aggs.append({op: ext, "count": count_where(present)})
     return {"rows": rows, "aggs": tuple(aggs)}
 

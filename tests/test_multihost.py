@@ -75,9 +75,18 @@ def test_two_process_mesh_psum_merge(tmp_path):
     script.write_text(_WORKER_SCRIPT)
     out_path = str(tmp_path / "result.json")
     port = _free_port()
+    import bqueryd_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(bqueryd_tpu.__file__))
     env = dict(os.environ)
     env.update(
         {
+            # the worker script lives in tmp_path, so the package root must
+            # be importable explicitly — python puts the script's directory
+            # on sys.path, not the parent's cwd
+            "PYTHONPATH": os.pathsep.join(
+                p for p in (pkg_root, env.get("PYTHONPATH")) if p
+            ),
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
             "BQUERYD_TPU_DIST_COORDINATOR": f"127.0.0.1:{port}",

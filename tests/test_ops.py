@@ -556,3 +556,44 @@ def test_matmul_route_auto_disables_on_cpu_backend(monkeypatch):
     assert m._matmul_profitable(
         (np.ones(64, dtype=np.int64),), ("sum",), 64, 8
     )
+
+
+def test_host_int_sum_fast_path_bit_exact():
+    """Small-range int64 sums take the single-bincount fast path; values
+    straddling the 2^53 partial-sum bound take the 16-bit-limb fallback.
+    Both must equal the python-int ground truth (mod-2^64 semantics)."""
+    rng = np.random.default_rng(44)
+    n, g = 50_000, 13
+    codes = rng.integers(0, g, n).astype(np.int32)
+    for lo, hi in [(-20_000, 20_000), (-(2**62), 2**62)]:
+        vals = rng.integers(lo, hi, n).astype(np.int64)
+        out = gb.host_partial_tables(codes, (vals,), ("sum",), g)
+        totals = [0] * g  # python ints: no overflow, wrap applied at the end
+        for c, v in zip(codes, vals):
+            totals[c] += int(v)
+        expect = np.array(
+            [(t % (1 << 64)) - (1 << 64) if (t % (1 << 64)) >= (1 << 63)
+             else t % (1 << 64) for t in totals],
+            dtype=np.int64,
+        )
+        np.testing.assert_array_equal(
+            out["aggs"][0]["sum"], expect, err_msg=f"range=({lo},{hi})",
+        )
+
+
+def test_host_partial_tables_all_valid_fast_path():
+    """No mask + no negative codes takes the unweighted-bincount fast path;
+    results must match the masked general path run on the same data."""
+    rng = np.random.default_rng(45)
+    n, g = 40_000, 11
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.integers(-(2**40), 2**40, n).astype(np.int64)
+    fast = gb.host_partial_tables(codes, (vals,), ("mean",), g)
+    general = gb.host_partial_tables(
+        codes, (vals,), ("mean",), g, mask=np.ones(n, dtype=bool)
+    )
+    np.testing.assert_array_equal(fast["rows"], general["rows"])
+    for key in fast["aggs"][0]:
+        np.testing.assert_array_equal(
+            fast["aggs"][0][key], general["aggs"][0][key]
+        )

@@ -266,3 +266,59 @@ def test_basket_expansion_null_baskets_are_one_group(tmp_path):
     got = got.sort_values("g").reset_index(drop=True)
     assert got["g"].tolist() == [1, 2]
     assert got["s"].tolist() == [20, 90]
+
+
+def test_null_dict_key_group_is_dropped(tmp_path):
+    """A dict-encoded groupby key with nulls (code -1) must NOT produce a
+    group: null-key rows vanish from the aggregation (pandas dropna
+    semantics, and the mesh executor's convention).  Regression test — the
+    old single-shard path re-factorized -1 into a real group whose collect
+    then indexed key_values[-1], emitting a duplicate of the LAST key with
+    the null rows' sum."""
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    df = pd.DataFrame(
+        {"k": ["a", None, "b", "a", None], "v": [1, 2, 3, 4, 5]}
+    )
+    root = str(tmp_path / "nullkey.bcolz")
+    CT.fromdataframe(df, root)
+    query = GroupByQuery(["k"], [["v", "sum", "s"]], [], aggregate=True)
+    payload = QueryEngine().execute_local(CT(root), query)
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload]))
+    got = got.sort_values("k").reset_index(drop=True)
+    assert got["k"].tolist() == ["a", "b"]
+    assert got["s"].tolist() == [5, 3]
+
+
+def test_null_dict_key_multikey_both_paths(tmp_path, monkeypatch):
+    """Multi-key composites poison null keys to -1; both the dense-combos
+    path (small composite space) and the compaction path (forced via a
+    zero cap) must drop them and agree with pandas."""
+    from bqueryd_tpu.models import query as qmod
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    df = pd.DataFrame(
+        {
+            "k": ["a", None, "b", "a", None, "b", "a"],
+            "g": [1, 1, 2, 2, 1, 2, 1],
+            "v": [1, 2, 3, 4, 5, 6, 7],
+        }
+    )
+    root = str(tmp_path / "nullmk.bcolz")
+    CT.fromdataframe(df, root)
+    expected = (
+        df.groupby(["k", "g"])["v"].sum().reset_index(name="s")
+    )
+    for cap in (qmod._DENSE_COMBO_CAP, 0):
+        monkeypatch.setattr(qmod, "_DENSE_COMBO_CAP", cap)
+        query = GroupByQuery(
+            ["k", "g"], [["v", "sum", "s"]], [], aggregate=True
+        )
+        payload = QueryEngine().execute_local(CT(root), query)
+        got = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads([payload])
+        )
+        got = got.sort_values(["k", "g"]).reset_index(drop=True)
+        assert got["k"].tolist() == expected["k"].tolist(), f"cap={cap}"
+        assert got["g"].tolist() == expected["g"].tolist(), f"cap={cap}"
+        assert got["s"].tolist() == expected["s"].tolist(), f"cap={cap}"
