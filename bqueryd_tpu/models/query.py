@@ -232,9 +232,39 @@ def device_dispatch_floor(remeasure=False):
     return _measured_floor
 
 
-#: assumed host aggregation cost per row (factorize + limb bincounts),
+#: assumed host aggregation cost per row (cached codes + fast-path
+#: bincounts: ~7ns/row measured at 1M rows x 9 groups, rounded up),
 #: used only to convert the measured dispatch floor into a row threshold
-_HOST_NS_PER_ROW = 20e-9
+_HOST_NS_PER_ROW = 8e-9
+
+#: cost when a measure misses the fast paths: the 16-bit-limb exact int
+#: sum (4 weighted bincounts) or np.minimum/maximum.at extrema run ~4x
+#: the fast-path rate, so near-threshold queries must not be host-routed
+#: on the optimistic estimate
+_HOST_NS_PER_ROW_SLOW = 32e-9
+
+
+def _host_ns_estimate(table, agg_list, n_rows):
+    """Per-row host-kernel cost for routing, from column METADATA only
+    (physical dtype + chunk min/max stats — no decode): integer sums whose
+    ``n x max|value|`` bound stays under 2^53 take the single-bincount
+    fast path; larger-magnitude (or stats-less) int sums and min/max pay
+    the slow rate."""
+    from bqueryd_tpu.ops.groupby import HOST_EXACT_SUM_BOUND
+
+    for in_col, op, _out in agg_list:
+        if op in ("min", "max"):
+            return _HOST_NS_PER_ROW_SLOW
+        if op in ("sum", "mean") and np.issubdtype(
+            table.physical_dtype(in_col), np.integer
+        ):
+            stats = table.col_stats(in_col)
+            if stats is None:
+                return _HOST_NS_PER_ROW_SLOW
+            bound = max(abs(int(stats[0])), abs(int(stats[1])))
+            if bound * max(int(n_rows), 1) >= HOST_EXACT_SUM_BOUND:
+                return _HOST_NS_PER_ROW_SLOW
+    return _HOST_NS_PER_ROW
 
 #: never host-route queries above this many rows, however slow the device
 #: link — large queries belong on the device program.  (A blanket
@@ -249,15 +279,17 @@ _HOST_ROUTE_CAP = 4_000_000
 _DENSE_COMBO_CAP = 1 << 16
 
 
-def host_kernel_rows():
+def host_kernel_rows(ns_per_row=None):
     """Row threshold below which mergeable aggregations run on the HOST
     (:func:`ops.host_partial_tables`) instead of paying a device round-trip.
 
     Latency-aware routing: when the device sits behind a network tunnel the
     dispatch+fetch floor dwarfs the kernel for small inputs, so the host is
     strictly faster; on local chips the measured floor is microseconds and
-    the threshold collapses to ~10k rows.  Override with
-    BQUERYD_TPU_HOST_KERNEL_ROWS (0 disables host routing)."""
+    the threshold collapses to ~10k rows.  ``ns_per_row`` lets the caller
+    pass a per-query cost estimate (:func:`_host_ns_estimate`); default is
+    the fast-path rate.  Override with BQUERYD_TPU_HOST_KERNEL_ROWS
+    (0 disables host routing)."""
     env = os.environ.get("BQUERYD_TPU_HOST_KERNEL_ROWS")
     if env is not None:
         try:
@@ -270,8 +302,8 @@ def host_kernel_rows():
                 "host routing disabled", env,
             )
             return 0
-    return min(int(device_dispatch_floor() / _HOST_NS_PER_ROW),
-               _HOST_ROUTE_CAP)
+    ns = _HOST_NS_PER_ROW if ns_per_row is None else ns_per_row
+    return min(int(device_dispatch_floor() / ns), _HOST_ROUTE_CAP)
 
 
 class QueryEngine:
@@ -439,7 +471,11 @@ class QueryEngine:
                     table.column_raw(a[0]) for _, a in mergeable
                 )
                 mops = tuple(a[1] for _, a in mergeable)
-                if len(dense) <= host_kernel_rows():
+                if len(dense) <= host_kernel_rows(
+                    _host_ns_estimate(
+                        table, [a for _, a in mergeable], len(dense)
+                    )
+                ):
                     # latency-aware routing: below the threshold the host
                     # beats the device's dispatch+fetch floor (see
                     # host_kernel_rows); identical partial semantics

@@ -61,6 +61,13 @@ def _accum_dtype(dtype):
     return dtype
 
 
+#: float64 mantissa bound: a weighted bincount over int64 values is exact
+#: iff every partial sum stays below this (|partial| <= n rows x max|v|).
+#: Shared with the host-routing cost estimate (models.query), which must
+#: rate queries beyond it at the limb-fallback cost.
+HOST_EXACT_SUM_BOUND = 2**53
+
+
 def _null_mask(values):
     if jnp.issubdtype(values.dtype, jnp.floating):
         return jnp.isnan(values)
@@ -334,11 +341,16 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
                 f"op {op!r} has no mergeable partial; use the dedicated kernel"
             )
         is_float = jnp.issubdtype(values.dtype, jnp.floating)
-        if is_float:
-            null = _null_mask(values)
-            present_row = add_int((valid & ~null).astype(jnp.bfloat16))
-        else:
+        if not is_float:
             present_row = valid_count_row
+        elif op == "count_na":
+            # consumes only the null row below — a presence row would be a
+            # wasted [n] bf16 contraction row in the stacked dot
+            present_row = None
+        else:
+            present_row = add_int(
+                (valid & ~_null_mask(values)).astype(jnp.bfloat16)
+            )
         if op in ("sum", "mean"):
             if not is_float:
                 v = values
@@ -369,8 +381,13 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif op == "count":
             plans.append(("count", op, present_row))
         elif op == "count_na":
-            null_row = add_int((valid & _null_mask(values)).astype(jnp.bfloat16))
-            plans.append(("count", op, null_row))
+            if is_float:
+                null_row = add_int(
+                    (valid & _null_mask(values)).astype(jnp.bfloat16)
+                )
+                plans.append(("count", op, null_row))
+            else:  # integers can't be null: no matmul row needed
+                plans.append(("zero_count", op))
         elif op in ("min", "max"):
             plans.append((op, op, values, present_row))
 
@@ -462,6 +479,8 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif kind == "count":
             _, _, ridx = plan
             aggs.append({"count": int_row(ridx).astype(jnp.int64)})
+        elif kind == "zero_count":
+            aggs.append({"count": jnp.zeros(n_groups, dtype=jnp.int64)})
         elif kind in ("min", "max"):
             _, _, values, present_row = plan
             present = valid & ~_null_mask(values)
@@ -495,10 +514,17 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
             raise ValueError(
                 f"op {op!r} has no mergeable partial; use the dedicated kernel"
             )
-        null = _null_mask(values)
-        present = valid & ~null
+        floating = jnp.issubdtype(values.dtype, jnp.floating)
+        # integer measures can't be null, so their presence IS key-validity:
+        # reuse the rows scatter instead of re-scanning 10M rows per count
+        null = _null_mask(values) if floating else None
+        present = valid if null is None else valid & ~null
+
+        def present_count():
+            return rows if null is None else int_count(present)
+
         if op in ("sum", "mean"):
-            if jnp.issubdtype(values.dtype, jnp.floating):
+            if floating:
                 contrib = jnp.where(present, values, 0).astype(
                     _accum_dtype(values.dtype)
                 )
@@ -523,17 +549,22 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
                     "sum": _int64_segment_sum(values, present, safe, n_groups)
                 }
             if op == "mean":
-                partial["count"] = int_count(present)
+                partial["count"] = present_count()
             aggs.append(partial)
         elif op == "count":
-            aggs.append({"count": int_count(present)})
+            aggs.append({"count": present_count()})
         elif op == "count_na":
-            aggs.append({"count": int_count(valid & null)})
+            na = (
+                int_count(valid & null)
+                if null is not None
+                else jnp.zeros(n_groups, dtype=jnp.int64)
+            )
+            aggs.append({"count": na})
         elif op in ("min", "max"):
             aggs.append(
                 {
                     op: _segment_extremum(op, values, present, safe, n_groups),
-                    "count": int_count(present),
+                    "count": present_count(),
                 }
             )
     return {"rows": rows, "aggs": tuple(aggs)}
@@ -582,7 +613,7 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
             # one float64-weighted bincount is exact when every partial sum
             # stays below 2^53: |any partial| <= n rows x max|value|
             bound = max(abs(int(v.min())), abs(int(v.max())))
-            if bound * len(v) < 2**53:
+            if bound * len(v) < HOST_EXACT_SUM_BOUND:
                 return np.bincount(
                     safe, weights=v.astype(np.float64), minlength=minlength
                 ).astype(np.int64)

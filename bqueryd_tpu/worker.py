@@ -559,13 +559,26 @@ class WorkerNode(WorkerBase):
         single shard -> single-device engine; other multi-shard shapes ->
         per-shard engine + host value-keyed merge.  Always returns ONE
         payload per CalcMessage."""
-        from bqueryd_tpu.models.query import host_kernel_rows
+        from bqueryd_tpu.models.query import (
+            _host_ns_estimate,
+            host_kernel_rows,
+        )
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
-        if MeshQueryExecutor.supports(query) and sum(
-            int(t.nrows) for t in tables
-        ) > host_kernel_rows():
+        total_rows = sum(int(t.nrows) for t in tables)
+        # the same per-query cost estimate execute_local uses, worst shard
+        # wins — a mismatched (optimistic) rate here would let slow-rated
+        # queries skip the mesh executor only to device-dispatch per shard
+        if MeshQueryExecutor.supports(query) and total_rows > host_kernel_rows(
+            max(
+                (
+                    _host_ns_estimate(t, query.agg_list, total_rows)
+                    for t in tables
+                ),
+                default=None,
+            )
+        ):
             # single shards go through the mesh executor too: its alignment +
             # HBM block caches make repeat queries one kernel dispatch.
             # Queries at or below the host threshold fall through to the
