@@ -81,6 +81,17 @@ BACKEND_FELL_BACK = False
 # Registration itself is no longer gated on warmup, but keep both generous.
 REGISTER_TIMEOUT = float(os.environ.get("BENCH_REGISTER_TIMEOUT_S", 900))
 RPC_TIMEOUT = float(os.environ.get("BENCH_RPC_TIMEOUT_S", 3600))
+# Per-config wall budget: a tunneled backend can wedge MID-RUN (observed:
+# configs 1-2 measured fine, then the next warmup hung >8 minutes with the
+# tunnel dead).  Without a bound one wedged query holds the whole benchmark
+# hostage for RPC_TIMEOUT and NOTHING gets recorded; with it, the completed
+# configs are emitted and the wedged one is marked timed_out.  The first
+# config's budget also absorbs backend bring-up (>9.5 min measured), so it
+# gets the larger allowance.
+CONFIG_TIMEOUT = float(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 900))
+FIRST_CONFIG_TIMEOUT = float(
+    os.environ.get("BENCH_FIRST_CONFIG_TIMEOUT_S", 2700)
+)
 
 
 def build_dataset():
@@ -454,7 +465,12 @@ def main():
         import jax
 
         floor_s = None
-        for config in CONFIGS:
+
+        def measure_config(config, out):
+            # writes into ``out``, NOT ``results``: a watchdog-abandoned
+            # thread that later completes must not mutate the dict the main
+            # thread is iterating for emission
+            nonlocal floor_s, head_base_df
             files, gcols, aggs, where = config_query(config, names)
             nrows = ROWS * len(files) // SHARDS
             # warmup: storage decode, XLA compile, HBM/alignment caches.
@@ -472,7 +488,8 @@ def main():
                 # measured after the first warmup so backend bring-up is done
                 floor_s = device_roundtrip_floor()
                 print(
-                    f"[bench] device dispatch+fetch floor: {floor_s*1e3:.1f} ms",
+                    f"[bench] device dispatch+fetch floor: "
+                    f"{floor_s*1e3:.1f} ms",
                     file=sys.stderr,
                     flush=True,
                 )
@@ -510,7 +527,7 @@ def main():
                 head_base_df = base_df
             check_result(result, base_df, gcols, aggs, config)
             worker_total = _phase_total(our_timings)
-            results[config] = {
+            out[config] = {
                 "rows": nrows,
                 "groups": len(base_df),
                 "framework_wall_s": round(our_wall, 4),
@@ -550,11 +567,48 @@ def main():
                 flush=True,
             )
 
+        wedged = False
+        for i, config in enumerate(CONFIGS):
+            # watchdog: one wedged query (tunnel death mid-run) must not
+            # hold the whole benchmark hostage for RPC_TIMEOUT — mark the
+            # config timed_out, stop measuring (the worker's calc thread is
+            # stuck, so later configs would wedge too) and emit what exists
+            budget = FIRST_CONFIG_TIMEOUT if i == 0 else CONFIG_TIMEOUT
+            box = {}
+
+            def run_one(config=config):
+                try:
+                    measure_config(config, box.setdefault("out", {}))
+                except BaseException as exc:  # re-raised on the main thread
+                    box["exc"] = exc
+
+            th = threading.Thread(target=run_one, daemon=True)
+            th.start()
+            th.join(budget)
+            if th.is_alive():
+                results[config] = {"timed_out": True, "budget_s": budget}
+                print(
+                    f"[bench] {config}: no result within {budget:.0f}s — "
+                    f"backend wedged; emitting completed configs only",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                wedged = True
+                break
+            if "exc" in box:
+                raise box["exc"]
+            results.update(box.get("out", {}))
+
         # one Pallas-kernel data point (VERDICT r3 item 6): re-run the
         # headline config with the fused one-hot kernel enabled.  The flag
         # is read per call in the un-jitted dispatcher, so toggling it at
         # runtime routes the same query through the Pallas path.
-        if HEADLINE in results and os.environ.get(
+        completed = {
+            name
+            for name, r in results.items()
+            if "framework_wall_s" in r
+        }
+        if not wedged and HEADLINE in completed and os.environ.get(
             "BENCH_PALLAS", "1"
         ) == "1":
             files, gcols, aggs, where = config_query(HEADLINE, names)
@@ -615,22 +669,38 @@ def main():
                 else:
                     os.environ["BQUERYD_TPU_PALLAS"] = prior_pallas
 
-        head_name = HEADLINE if HEADLINE in results else CONFIGS[0]
-        head = results[head_name]
+        if HEADLINE in completed:
+            head_name = HEADLINE
+        elif completed:
+            head_name = next(c for c in CONFIGS if c in completed)
+        else:
+            head_name = None
+        head = results.get(head_name, {})
         metric = (
             "taxi_groupby_sum_10shard_e2e_rows_per_sec"
             if head_name == HEADLINE
             else f"taxi_groupby_{head_name}_e2e_rows_per_sec"
+            if head_name
+            else "taxi_groupby_none_completed"
         )
         detail_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
         )
+        if completed:
+            backend_name, n_devices = jax.default_backend(), len(jax.devices())
+        else:
+            # nothing ever completed — the backend may be wedged mid-init,
+            # and jax.default_backend()/jax.devices() on a dead tunnel can
+            # block uninterruptibly (see ensure_backend), which would hang
+            # the very emission the watchdog exists to protect
+            backend_name = os.environ.get("JAX_PLATFORMS") or "uninitialized"
+            n_devices = None
         full_detail = {
             "rows": ROWS,
             "shards": SHARDS,
-            "backend": jax.default_backend(),
+            "backend": backend_name,
             "backend_fell_back": BACKEND_FELL_BACK,
-            "n_devices": len(jax.devices()),
+            "n_devices": n_devices,
             "device_roundtrip_floor_s": (
                 None if floor_s is None else round(floor_s, 4)
             ),
@@ -644,21 +714,25 @@ def main():
         # the ONE machine-read line: compact (no phase timings — those live
         # in BENCH_DETAIL.json), backend/n_devices up front, printed LAST
         compact_configs = {
-            name: {
-                "wall_s": r["framework_wall_s"],
-                "cold_s": r["cold_wall_s"],
-                "base_s": r["reference_shaped_wall_s"],
-                "speedup": r["speedup"],
-            }
+            name: (
+                {
+                    "wall_s": r["framework_wall_s"],
+                    "cold_s": r["cold_wall_s"],
+                    "base_s": r["reference_shaped_wall_s"],
+                    "speedup": r["speedup"],
+                }
+                if "framework_wall_s" in r
+                else r  # timed_out marker
+            )
             for name, r in results.items()
         }
         print(
             json.dumps(
                 {
                     "metric": metric,
-                    "value": head["rows_per_sec"],
+                    "value": head.get("rows_per_sec", 0),
                     "unit": "rows/s",
-                    "vs_baseline": head["speedup"],
+                    "vs_baseline": head.get("speedup", 0),
                     "detail": {
                         "backend": full_detail["backend"],
                         "backend_fell_back": BACKEND_FELL_BACK,
