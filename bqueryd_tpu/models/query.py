@@ -175,7 +175,8 @@ class ResultPayload(dict):
         return cls(format=PAYLOAD_FORMAT, kind="rows", columns=columns, order=order)
 
     @classmethod
-    def partials(cls, key_cols, keys, rows, aggs, ops, out_cols):
+    def partials(cls, key_cols, keys, rows, aggs, ops, out_cols,
+                 value_kinds=None):
         return cls(
             format=PAYLOAD_FORMAT,
             kind="partials",
@@ -185,6 +186,14 @@ class ResultPayload(dict):
             aggs=aggs,        # list of {partname: np.ndarray[G]}
             ops=list(ops),
             out_cols=list(out_cols),
+            # storage kind per agg (None | 'datetime'): partials of datetime
+            # measures ride and merge as raw int64; finalize views min/max
+            # back to datetime64[ns] (NaT for empty groups)
+            value_kinds=(
+                [None] * len(list(out_cols))
+                if value_kinds is None
+                else list(value_kinds)
+            ),
         )
 
     def to_bytes(self):
@@ -360,6 +369,19 @@ class QueryEngine:
         codes, uniques = ops.factorize(raw)
         if kind == "datetime":
             uniques = uniques.view("datetime64[ns]")
+        # NaN/NaT uniques are nulls, not values: poison their codes to -1 so
+        # those rows drop from group keys (pandas dropna) and from distinct
+        # sets (pandas nunique skips nulls) — ops.factorize itself treats
+        # them as ordinary keys and documents that callers pre-filter
+        null_at = None
+        if kind == "datetime":
+            null_at = np.flatnonzero(np.isnat(uniques))
+        elif np.issubdtype(np.asarray(uniques).dtype, np.floating):
+            null_at = np.flatnonzero(np.isnan(uniques))
+        if null_at is not None and len(null_at):
+            codes = np.where(
+                np.isin(codes, null_at), np.int64(-1), codes
+            )
         self._factorize_cache.put(
             cache_key, (codes, uniques), nbytes=codes.nbytes + uniques.nbytes
         )
@@ -388,6 +410,16 @@ class QueryEngine:
     # -- execution ---------------------------------------------------------
     def execute_local(self, table, query: GroupByQuery) -> ResultPayload:
         from bqueryd_tpu import ops
+
+        if query.aggregate:
+            # reject pandas-meaningless datetime sums/means before any
+            # decode/factorize work is spent on the query
+            for in_col, op in zip(query.in_cols, query.ops):
+                if op in ("sum", "mean") and table.kind(in_col) == "datetime":
+                    raise ValueError(
+                        f"{op!r} is not defined for datetime "
+                        f"column {in_col!r}"
+                    )
 
         with self._phase("prune"):
             if query.where_terms and not ops.shard_can_match(
@@ -471,6 +503,15 @@ class QueryEngine:
                     table.column_raw(a[0]) for _, a in mergeable
                 )
                 mops = tuple(a[1] for _, a in mergeable)
+                # datetime measures: NaT (int64 min) is a null sentinel so
+                # those rows skip counts/extrema like float NaNs (pandas);
+                # datetime sums/means were rejected on entry
+                sentinels = tuple(
+                    np.iinfo(np.int64).min
+                    if table.kind(a[0]) == "datetime"
+                    else None
+                    for _, a in mergeable
+                )
                 if len(dense) <= host_kernel_rows(
                     _host_ns_estimate(
                         table, [a for _, a in mergeable], len(dense)
@@ -481,7 +522,7 @@ class QueryEngine:
                     # host_kernel_rows); identical partial semantics
                     partials = ops.host_partial_tables(
                         dense.astype(np.int32), measures, mops, n_groups,
-                        mask_arr,
+                        mask_arr, null_sentinels=sentinels,
                     )
                 else:
                     import jax
@@ -489,7 +530,7 @@ class QueryEngine:
                     partials = jax.device_get(  # ONE batched D2H round-trip
                         ops.partial_tables(
                             dense.astype(np.int32), measures, mops, n_groups,
-                            mask_arr,
+                            mask_arr, null_sentinels=sentinels,
                         )
                     )
                 rows = partials["rows"]
@@ -592,6 +633,10 @@ class QueryEngine:
                 aggs=aggs,
                 ops=query.ops,
                 out_cols=query.out_cols,
+                value_kinds=[
+                    "datetime" if table.kind(a[0]) == "datetime" else None
+                    for a in query.agg_list
+                ],
             )
 
     def _raw_rows(self, table, query, mask):

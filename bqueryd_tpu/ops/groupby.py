@@ -74,6 +74,33 @@ def _null_mask(values):
     return jnp.zeros(values.shape, dtype=bool)
 
 
+def _measure_null(values, sentinel):
+    """Per-measure null rows, or None when the measure cannot be null.
+
+    ``sentinel`` marks an integer encoding whose one reserved value means
+    missing — datetime columns store NaT as int64 min (pandas convention) —
+    so those rows must vanish from counts/extrema exactly like float NaNs.
+    """
+    if sentinel is not None:
+        return values == jnp.asarray(sentinel, dtype=values.dtype)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return jnp.isnan(values)
+    return None
+
+
+def _normalize_sentinels(null_sentinels, n):
+    if null_sentinels is None:
+        return (None,) * n
+    t = tuple(
+        None if s is None else int(s) for s in null_sentinels
+    )
+    if len(t) != n:
+        raise ValueError(
+            f"null_sentinels has {len(t)} entries for {n} measures"
+        )
+    return t
+
+
 #: rows per scatter block in the exact-int64 segment sum.  A 16-bit limb's
 #: block sum stays below ``2^16 (max limb) * 2^16 (rows) = 2^32``: exactly
 #: representable in the int32 scatter's mod-2^32 arithmetic, recovered by a
@@ -220,7 +247,8 @@ def _matmul_profitable(measures, ops, n, n_groups):
     return not measures  # rows-count-only query still benefits
 
 
-def partial_tables(codes, measures, ops, n_groups, mask=None):
+def partial_tables(codes, measures, ops, n_groups, mask=None,
+                   null_sentinels=None):
     """Compute per-group partial tables for one shard.
 
     codes:    int[n] dense group codes in [0, n_groups); negative = null key
@@ -228,6 +256,12 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
     measures: tuple of value arrays [n], one per aggregation
     ops:      static tuple of op names aligned with measures (MERGEABLE_OPS)
     mask:     optional bool[n] row filter (where_terms pushdown)
+    null_sentinels: optional tuple aligned with measures; an int entry marks
+              that integer value as the measure's missing-data encoding
+              (datetime NaT = int64 min) so those rows skip counts/extrema
+              the way float NaNs do.  sum/mean measures may not carry a
+              sentinel (the engine rejects datetime sums as pandas-meaningless
+              before reaching the kernels).
 
     Returns a pytree: {"rows": int64[n_groups],
                        "aggs": tuple of per-measure partial dicts}.
@@ -238,6 +272,16 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
     """
     ops = tuple(ops)
     measures = tuple(measures)
+    null_sentinels = _normalize_sentinels(null_sentinels, len(measures))
+    for sentinel, op in zip(null_sentinels, ops):
+        if sentinel is not None and op in ("sum", "mean"):
+            # the MXU limb path contracts raw rows (exclusion rides the
+            # one-hot of the SHARED codes, so per-measure nulls can't be
+            # expressed there) and a sentinel sum is semantically undefined
+            # anyway — the engine raises long before this
+            raise ValueError(
+                f"op {op!r} cannot aggregate a sentinel-null measure"
+            )
     if _matmul_profitable(measures, ops, int(codes.shape[0]), int(n_groups)):
         # env flags are read HERE, outside jit, so toggling them takes effect
         # per call instead of being frozen into the first trace
@@ -250,8 +294,12 @@ def partial_tables(codes, measures, ops, n_groups, mask=None):
             # group count where its smallest one-hot tile still fits
             use_pallas=pallas_groupby.pallas_enabled()
             and int(n_groups) <= pallas_groupby.pallas_groups_limit(),
+            null_sentinels=null_sentinels,
         )
-    return _partial_tables_scatter(codes, measures, ops, int(n_groups), mask)
+    return _partial_tables_scatter(
+        codes, measures, ops, int(n_groups), mask,
+        null_sentinels=null_sentinels,
+    )
 
 
 def _segment_extremum(kind, values, present, safe, n_groups):
@@ -300,10 +348,11 @@ def _limb_rows(values, nbits):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_groups", "ops", "use_pallas")
+    jax.jit,
+    static_argnames=("n_groups", "ops", "use_pallas", "null_sentinels"),
 )
 def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
-                       use_pallas=False):
+                       use_pallas=False, null_sentinels=None):
     """MXU path: one ``dot_general`` of stacked bf16 rows (a ones row for
     counts, byte limbs for int sums, a 3-limb bf16 split for float32 sums)
     against the blocked one-hot of the folded codes."""
@@ -333,24 +382,24 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
 
     valid_count_row = add_int(valid.astype(jnp.bfloat16))
 
+    sentinels = _normalize_sentinels(null_sentinels, len(measures))
     # per-measure row plans, resolved after the single dot below
     plans = []
-    for values, op in zip(measures, ops):
+    for values, op, sentinel in zip(measures, ops, sentinels):
         if op not in MERGEABLE_OPS:
             raise ValueError(
                 f"op {op!r} has no mergeable partial; use the dedicated kernel"
             )
         is_float = jnp.issubdtype(values.dtype, jnp.floating)
-        if not is_float:
+        null = _measure_null(values, sentinel)
+        if null is None:
             present_row = valid_count_row
         elif op == "count_na":
             # consumes only the null row below — a presence row would be a
             # wasted [n] bf16 contraction row in the stacked dot
             present_row = None
         else:
-            present_row = add_int(
-                (valid & ~_null_mask(values)).astype(jnp.bfloat16)
-            )
+            present_row = add_int((valid & ~null).astype(jnp.bfloat16))
         if op in ("sum", "mean"):
             if not is_float:
                 v = values
@@ -381,15 +430,15 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif op == "count":
             plans.append(("count", op, present_row))
         elif op == "count_na":
-            if is_float:
+            if null is not None:
                 null_row = add_int(
-                    (valid & _null_mask(values)).astype(jnp.bfloat16)
+                    (valid & null).astype(jnp.bfloat16)
                 )
                 plans.append(("count", op, null_row))
-            else:  # integers can't be null: no matmul row needed
+            else:  # plain integers can't be null: no matmul row needed
                 plans.append(("zero_count", op))
         elif op in ("min", "max"):
-            plans.append((op, op, values, present_row))
+            plans.append((op, op, values, present_row, null))
 
     if use_pallas:
         from bqueryd_tpu.ops import pallas_groupby
@@ -482,8 +531,8 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif kind == "zero_count":
             aggs.append({"count": jnp.zeros(n_groups, dtype=jnp.int64)})
         elif kind in ("min", "max"):
-            _, _, values, present_row = plan
-            present = valid & ~_null_mask(values)
+            _, _, values, present_row, null = plan
+            present = valid if null is None else valid & ~null
             ext = _segment_extremum(kind, values, present, safe, n_groups)
             aggs.append(
                 {kind: ext, "count": int_row(present_row).astype(jnp.int64)}
@@ -491,8 +540,11 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
     return {"rows": rows_count, "aggs": tuple(aggs)}
 
 
-@functools.partial(jax.jit, static_argnames=("n_groups", "ops"))
-def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
+@functools.partial(
+    jax.jit, static_argnames=("n_groups", "ops", "null_sentinels")
+)
+def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
+                            null_sentinels=None):
     """Scatter path: blocked-int32 segment sums (exact, no s64 scatter)."""
     valid = codes >= 0
     if mask is not None:
@@ -508,16 +560,18 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
 
     rows = int_count(valid)
 
+    sentinels = _normalize_sentinels(null_sentinels, len(measures))
     aggs = []
-    for values, op in zip(measures, ops):
+    for values, op, sentinel in zip(measures, ops, sentinels):
         if op not in MERGEABLE_OPS:
             raise ValueError(
                 f"op {op!r} has no mergeable partial; use the dedicated kernel"
             )
         floating = jnp.issubdtype(values.dtype, jnp.floating)
-        # integer measures can't be null, so their presence IS key-validity:
-        # reuse the rows scatter instead of re-scanning 10M rows per count
-        null = _null_mask(values) if floating else None
+        # plain integer measures can't be null, so their presence IS
+        # key-validity: reuse the rows scatter instead of re-scanning 10M
+        # rows per count; sentinel measures (datetime NaT) null like floats
+        null = _measure_null(values, sentinel)
         present = valid if null is None else valid & ~null
 
         def present_count():
@@ -570,7 +624,8 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None):
     return {"rows": rows, "aggs": tuple(aggs)}
 
 
-def host_partial_tables(codes, measures, ops, n_groups, mask=None):
+def host_partial_tables(codes, measures, ops, n_groups, mask=None,
+                        null_sentinels=None):
     """Pure-NumPy :func:`partial_tables` — same pytree, host execution.
 
     Exists for latency-aware routing: on a remote/tunneled device a single
@@ -635,22 +690,30 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None):
             )
         return total.astype(np.int64)
 
-    def null_mask(values):
+    def null_mask(values, sentinel):
+        if sentinel is not None:
+            return values == np.asarray(sentinel, dtype=values.dtype)
         if np.issubdtype(values.dtype, np.floating):
             return np.isnan(values)
         return np.zeros(values.shape, dtype=bool)
 
     rows = count_where(None if all_valid else valid)
+    sentinels = _normalize_sentinels(null_sentinels, len(measures))
     aggs = []
-    for values, op in zip(measures, ops):
+    for values, op, sentinel in zip(measures, ops, sentinels):
         if op not in MERGEABLE_OPS:
             raise ValueError(
                 f"op {op!r} has no mergeable partial; use the dedicated kernel"
             )
+        if sentinel is not None and op in ("sum", "mean"):
+            raise ValueError(
+                f"op {op!r} cannot aggregate a sentinel-null measure"
+            )
         values = np.asarray(values)
-        null = null_mask(values)
-        has_null = null.any() if np.issubdtype(
-            values.dtype, np.floating
+        null = null_mask(values, sentinel)
+        has_null = null.any() if (
+            sentinel is not None
+            or np.issubdtype(values.dtype, np.floating)
         ) else False
         # present=None means "every row contributes" — the fast paths above
         present = None if (all_valid and not has_null) else (valid & ~null)

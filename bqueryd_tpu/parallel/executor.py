@@ -115,6 +115,19 @@ def _stored_dtype(tables, col):
     return np.result_type(*dts)
 
 
+def _measure_kind(tables, col):
+    """'datetime' when every shard stores ``col`` as a datetime, None for
+    plain numeric/dict; mixed storage kinds across shards are a data error."""
+    kinds = {t.kind(col) for t in tables}
+    if kinds == {"datetime"}:
+        return "datetime"
+    if "datetime" in kinds:
+        raise ValueError(
+            f"column {col!r} is datetime on some shards but not others"
+        )
+    return None
+
+
 def _where_signature(query):
     """Hashable, canonical identity of a query's row-filter."""
     from bqueryd_tpu.models.query import freeze_value
@@ -308,6 +321,19 @@ class MeshQueryExecutor:
                 "MeshQueryExecutor handles mergeable aggregations only; "
                 "route distinct-count / raw-rows queries per shard"
             )
+        # datetime measures ride the mesh as raw int64 with NaT (int64 min)
+        # declared as a null sentinel so NaT rows skip counts and extrema
+        # exactly like float NaNs (pandas semantics).  Sums/means of
+        # datetimes are rejected HERE, before any alignment/decode/upload
+        # work is spent on an invalid query.
+        measure_kinds = tuple(
+            _measure_kind(tables, col) for col in query.in_cols
+        )
+        for col, kind, op in zip(query.in_cols, measure_kinds, query.ops):
+            if kind == "datetime" and op in ("sum", "mean"):
+                raise ValueError(
+                    f"{op!r} is not defined for datetime column {col!r}"
+                )
         engine = self._engine()
 
         with self._phase("prune"):
@@ -437,6 +463,10 @@ class MeshQueryExecutor:
                     pool.shutdown(wait=True)
 
         with self._phase("aggregate"):
+            sentinels = tuple(
+                np.iinfo(np.int64).min if k == "datetime" else None
+                for k in measure_kinds
+            )
             # returns host numpy partials; with packed fetch (default) the
             # whole merged pytree comes back as ONE device buffer — per-leaf
             # pulls cost a full transport round-trip each on tunneled/remote
@@ -444,6 +474,7 @@ class MeshQueryExecutor:
             merged = _mesh_partials(
                 mesh, self.axis_name, query.ops, n_groups,
                 codes_d, tuple(measures_d),
+                null_sentinels=sentinels,
             )
 
         with self._phase("collect"):
@@ -482,6 +513,7 @@ class MeshQueryExecutor:
                 aggs=aggs,
                 ops=query.ops,
                 out_cols=query.out_cols,
+                value_kinds=list(measure_kinds),
             )
 
 
@@ -524,7 +556,8 @@ def packed_fetch_enabled():
 
 
 @functools.lru_cache(maxsize=64)
-def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack):
+def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
+                  null_sentinels=None):
     """Build + cache the jitted shard_map program for one query shape.
 
     The key carries everything that can change the traced program — measure
@@ -546,6 +579,7 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack):
             tuple(m[0] for m in measure_blks),
             agg_ops,
             n_groups,
+            null_sentinels=null_sentinels,
         )
         merged = ops.psum_partials(partials, axis)
         if not pack:
@@ -578,7 +612,8 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack):
 _packed_fetch_broken = False
 
 
-def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d):
+def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
+                   null_sentinels=None):
     """Run the mesh program and return the merged partials pytree ON HOST
     (numpy leaves) — fetching one packed buffer when packing is enabled."""
     global _packed_fetch_broken
@@ -591,6 +626,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d):
         return _mesh_program(
             mesh, axis, tuple(agg_ops), int(n_groups), in_dtypes,
             int(codes_d.shape[1]), pack_flag,
+            null_sentinels,  # part of the lru key: it changes the trace
         )
 
     if pack:

@@ -160,8 +160,14 @@ def _merge_partials(payloads):
     key_cols = first["key_cols"]
     ops = first["ops"]
     out_cols = first["out_cols"]
+    value_kinds = first.get("value_kinds")
     for p in payloads[1:]:
-        if p["key_cols"] != key_cols or p["ops"] != ops or p["out_cols"] != out_cols:
+        if (
+            p["key_cols"] != key_cols
+            or p["ops"] != ops
+            or p["out_cols"] != out_cols
+            or p.get("value_kinds") != value_kinds
+        ):
             raise ValueError("partial payloads disagree on query shape")
     if len(payloads) == 1:
         return dict(first)
@@ -219,6 +225,7 @@ def _merge_partials(payloads):
         "aggs": aggs,
         "ops": ops,
         "out_cols": out_cols,
+        "value_kinds": value_kinds,
     }
 
 
@@ -286,7 +293,10 @@ def finalize_table(merged):
     order = list(merged["key_cols"]) + list(out_cols)
     columns = dict(merged["keys"])
     rows = merged["rows"]
-    for agg, op, out_col in zip(merged["aggs"], merged["ops"], out_cols):
+    value_kinds = merged.get("value_kinds") or [None] * len(out_cols)
+    for agg, op, out_col, vkind in zip(
+        merged["aggs"], merged["ops"], out_cols, value_kinds
+    ):
         if op == "mean":
             count = agg["count"]
             with np.errstate(invalid="ignore", divide="ignore"):
@@ -308,7 +318,13 @@ def finalize_table(merged):
         elif op in ("min", "max"):
             values = agg[op]
             empty = agg["count"] == 0
-            if np.issubdtype(values.dtype, np.floating):
+            if vkind == "datetime":
+                # partials merged as raw int64; NaT (int64 min) for groups
+                # whose values were all-NaT, then back to datetime64[ns]
+                values = np.where(
+                    empty, np.iinfo(np.int64).min, values.astype(np.int64)
+                ).view("datetime64[ns]")
+            elif np.issubdtype(values.dtype, np.floating):
                 values = np.where(empty, np.nan, values)
             else:
                 values = np.where(empty, 0, values)

@@ -191,10 +191,11 @@ def test_pallas_route_capped_at_groups_limit(monkeypatch):
     seen = {}
     real = gbm._partial_tables_mm
 
-    def spy(codes, measures, ops_, n_groups, mask=None, use_pallas=False):
+    def spy(codes, measures, ops_, n_groups, mask=None, use_pallas=False,
+            **kw):
         seen["use_pallas"] = use_pallas
         return real(codes, measures, ops_, n_groups, mask,
-                    use_pallas=use_pallas)
+                    use_pallas=use_pallas, **kw)
 
     monkeypatch.setattr(gbm, "_partial_tables_mm", spy)
     monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
