@@ -108,15 +108,23 @@ CASES = [
 ]
 
 
-def _expected(frames, gcols, agg_list, where):
-    df = pd.concat(frames, ignore_index=True)
+def _filter_df(df, where):
     for col, op, val in where:
         if op == ">":
             df = df[df[col] > val]
         elif op == "<=":
             df = df[df[col] <= val]
+        elif op == "in":
+            df = df[df[col].isin(val)]
+        elif op == "not in":
+            df = df[~df[col].isin(val)]
         else:
             raise NotImplementedError(op)
+    return df
+
+
+def _expected(frames, gcols, agg_list, where):
+    df = _filter_df(pd.concat(frames, ignore_index=True), where)
     gb = df.groupby(gcols, dropna=True)
     out = {}
     for in_col, op, out_col in agg_list:
@@ -203,3 +211,75 @@ def test_mesh_executor_matches_pandas(shards, case_i):
     payload = MeshQueryExecutor().execute(tables, query)
     got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads([payload]))
     _compare(got, _expected(frames, gcols, agg_list, where), gcols, agg_list)
+
+
+# ---------------------------------------------------------------------------
+# remaining query surfaces: raw rows, in/not-in predicates, basket expansion
+# ---------------------------------------------------------------------------
+
+RAW_CASES = [
+    (["k_int"], ["v_small", "v_float"], [["sel", ">", 0.6]]),
+    (["k_str"], ["v_small"], [["k_int", "in", [1, 3, 5]]]),
+    (["k_int"], ["v_big"], [["k_int", "not in", [0, 2]], ["sel", "<=", 0.8]]),
+]
+
+
+
+
+@pytest.mark.parametrize("case_i", range(len(RAW_CASES)))
+def test_raw_rows_match_pandas(shards, case_i):
+    """aggregate=False: the filtered, selected rows concatenated across
+    shards must equal pandas boolean filtering (compared as sorted
+    multisets — cross-shard order is concatenation order by contract)."""
+    frames, tables = shards
+    gcols, in_cols, where = RAW_CASES[case_i]
+    agg_list = [[c, "sum", c] for c in in_cols]
+    query = GroupByQuery(gcols, agg_list, where, aggregate=False)
+    engine = QueryEngine()
+    payloads = [engine.execute_local(t, query) for t in tables]
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads(payloads))
+    expected = _filter_df(pd.concat(frames, ignore_index=True), where)
+    cols = list(dict.fromkeys(gcols + in_cols))
+    expected = expected[cols]
+    assert len(got) == len(expected)
+    g = got.sort_values(cols).reset_index(drop=True)
+    e = expected.sort_values(cols).reset_index(drop=True)
+    for c in cols:
+        if np.issubdtype(np.asarray(e[c]).dtype, np.floating):
+            np.testing.assert_allclose(
+                g[c].astype(np.float64), e[c].astype(np.float64),
+                rtol=1e-6, equal_nan=True, err_msg=c,
+            )
+        else:
+            assert g[c].astype(str).tolist() == e[c].astype(str).tolist(), c
+
+
+@pytest.mark.parametrize(
+    "where",
+    [
+        [["k_int", "in", [0, 2, 6]]],
+        [["k_str", "in", ["a", "c"]]],
+        [["k_str", "not in", ["b"]]],
+        [["k_int", "not in", [1]], ["sel", ">", 0.5]],
+    ],
+)
+def test_in_predicates_match_pandas(shards, where):
+    """'in'/'not in' terms (incl. on dict columns, where membership is
+    translated to physical codes) must agree with pandas isin on both
+    execution paths.  NOTE pandas asymmetry: a null key row never matches
+    'in', but ~isin() keeps nulls — the framework follows isin for the
+    selection in both polarities, so expectation uses isin directly."""
+    frames, tables = shards
+    gcols, agg_list = ["k_int"], [["v_small", "sum", "s"]]
+    query = GroupByQuery(gcols, agg_list, where, aggregate=True)
+    engine = QueryEngine()
+    payloads = [engine.execute_local(t, query) for t in tables]
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads(payloads))
+    got_mesh = hostmerge.payload_to_dataframe(
+        hostmerge.merge_payloads(
+            [MeshQueryExecutor().execute(tables, query)]
+        )
+    )
+    expected = _expected(frames, gcols, agg_list, where)
+    _compare(got, expected, gcols, agg_list)
+    _compare(got_mesh, expected, gcols, agg_list)
