@@ -38,8 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 #: exactness bound (block sums < 2^24) applies unchanged
 BLOCK_K = 32768
 
-#: sublane multiple for the stacked-rows operand
-_SUBLANE = 8
+#: sublane multiple for the stacked-rows operand.  16, not 8: the lhs block
+#: is bf16, whose native Mosaic tile is (16, 128) — an 8-sublane bf16 block
+#: relies on small-tile support that an older Mosaic may lack, and one row
+#: of zero padding costs nothing
+_SUBLANE = 16
 
 
 def pallas_enabled():
@@ -164,8 +167,8 @@ def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
 
     codes: int32[n] folded group codes (negative = contributes nowhere)
     rows:  bf16[R, n] stacked reduction rows (R == n_rows)
-    Returns float32[nb, R8, G128] where R8/G128 are R and n_groups rounded up
-    to hardware tile multiples — callers slice ``[:, :R, :G]``.
+    Returns float32[nb, R16, G128] where R16/G128 are R and n_groups rounded
+    up to hardware tile multiples — callers slice ``[:, :R, :G]``.
     """
     if not fits_vmem(n_rows, n_groups):
         # the invariant lives here, not only in the dispatcher's boolean:

@@ -283,3 +283,50 @@ def test_in_predicates_match_pandas(shards, where):
     expected = _expected(frames, gcols, agg_list, where)
     _compare(got, expected, gcols, agg_list)
     _compare(got_mesh, expected, gcols, agg_list)
+
+
+def test_sorted_count_distinct_on_basket_sorted_data(tmp_path):
+    """sorted_count_distinct counts value runs within each group; on data
+    sorted by (group, value) per shard — the basket layout the op exists
+    for — the summed run counts equal pandas nunique per shard, and the
+    cross-shard merge is additive by contract."""
+    rng = np.random.default_rng(77)
+    tables, frames = [], []
+    for i in range(2):
+        n = 3_000
+        df = pd.DataFrame(
+            {
+                "g": np.sort(rng.integers(0, 5, n)).astype(np.int64),
+                "v": rng.integers(0, 40, n).astype(np.int64),
+            }
+        ).sort_values(["g", "v"], kind="stable").reset_index(drop=True)
+        p = str(tmp_path / f"s{i}.bcolzs")
+        ctable.fromdataframe(df, p)
+        tables.append(ctable(p, mode="r"))
+        frames.append(df)
+    query = GroupByQuery(
+        ["g"], [["v", "sorted_count_distinct", "nd"]], [], aggregate=True
+    )
+    engine = QueryEngine()
+    payloads = [engine.execute_local(t, query) for t in tables]
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads(payloads))
+    got = got.sort_values("g").reset_index(drop=True)
+    expected = sum(
+        df.groupby("g")["v"].nunique() for df in frames
+    ).sort_index()
+    assert got["g"].tolist() == expected.index.tolist()
+    assert got["nd"].tolist() == expected.tolist()
+
+
+def test_datetime_sum_mean_rejected(shards):
+    """pandas-meaningless datetime sums/means raise on entry, on both
+    execution paths (the README cites this suite for that behavior)."""
+    frames, tables = shards
+    for op in ("sum", "mean"):
+        query = GroupByQuery(
+            ["k_int"], [["t", op, "x"]], [], aggregate=True
+        )
+        with pytest.raises(ValueError, match="not defined for datetime"):
+            QueryEngine().execute_local(tables[0], query)
+        with pytest.raises(ValueError, match="not defined for datetime"):
+            MeshQueryExecutor().execute(tables, query)
