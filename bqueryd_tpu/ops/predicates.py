@@ -23,7 +23,6 @@ min/max stats and dictionary membership decide whether a shard can contain any
 matching row before anything is decompressed or shipped to the device.
 """
 
-import numpy as np
 
 WHERE_OPS = ("==", "!=", "<", "<=", ">", ">=", "in", "not in")
 
@@ -155,8 +154,3 @@ def shard_can_match(table, where_terms_list):
             continue
     return True
 
-
-def mask_to_indices(mask):
-    """Materialize mask as row indices (host), for the aggregate=False
-    raw-rows path."""
-    return np.flatnonzero(np.asarray(mask))
