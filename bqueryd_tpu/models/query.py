@@ -259,14 +259,33 @@ def _host_ns_estimate(table, agg_list, n_rows):
     ``n x max|value|`` bound stays under 2^53 take the single-bincount
     fast path; larger-magnitude (or stats-less) int sums and min/max pay
     the slow rate."""
-    from bqueryd_tpu.ops.groupby import HOST_EXACT_SUM_BOUND
+    from bqueryd_tpu.ops.groupby import (
+        _NATIVE_GROUPBY_MIN_ROWS,
+        HOST_EXACT_SUM_BOUND,
+    )
 
+    native_sums = None  # computed lazily: import + symbol probe
     for in_col, op, _out in agg_list:
         if op in ("min", "max"):
             return _HOST_NS_PER_ROW_SLOW
         if op in ("sum", "mean") and np.issubdtype(
             table.physical_dtype(in_col), np.integer
         ):
+            if native_sums is None:
+                from bqueryd_tpu.storage import native
+
+                # the C++ kernel accumulates int sums in uint64 (exact at
+                # any magnitude), so queries it will take have no slow
+                # fallback to price in.  (It declines above its group
+                # ceiling — unknown until factorize — in which case the
+                # numpy limb path runs mis-rated; high-cardinality host
+                # routes are rare enough to accept that.)
+                native_sums = (
+                    n_rows >= _NATIVE_GROUPBY_MIN_ROWS
+                    and native.groupby_available()
+                )
+            if native_sums:
+                continue
             stats = table.col_stats(in_col)
             if stats is None:
                 return _HOST_NS_PER_ROW_SLOW

@@ -61,6 +61,12 @@ def _accum_dtype(dtype):
     return dtype
 
 
+#: native host-groupby routing: below the row floor thread spawn overhead
+#: beats the striping win; above the group ceiling the per-thread [G]
+#: accumulators (16 B x workers x G) stop being cache/memory friendly
+_NATIVE_GROUPBY_MIN_ROWS = 200_000
+_NATIVE_GROUPBY_MAX_GROUPS = 1 << 18
+
 #: float64 mantissa bound: a weighted bincount over int64 values is exact
 #: iff every partial sum stays below this (|partial| <= n rows x max|v|).
 #: Shared with the host-routing cost estimate (models.query), which must
@@ -653,7 +659,34 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
     )
     minlength = max(int(n_groups), 1)
 
+    # Native fast path: the striped C++ kernels in native/tpucolz.cpp run the
+    # same reductions multithreaded, and their int sums accumulate in uint64
+    # (mod 2^64) so they are exact at ANY magnitude — no 2^53 bincount bound.
+    # Bounded by a row floor (thread spawn overhead) and a group ceiling
+    # (per-thread accumulator memory).
+    native_mod = None
+    if (
+        len(codes) >= _NATIVE_GROUPBY_MIN_ROWS
+        and minlength <= _NATIVE_GROUPBY_MAX_GROUPS
+    ):
+        from bqueryd_tpu.storage import native as _native
+
+        if _native.groupby_available():
+            native_mod = _native
+    codes32 = base_mask = None
+    if native_mod is not None:
+        codes32 = np.ascontiguousarray(codes, dtype=np.int32)
+        if not all_valid:
+            # numpy bool is 1 byte: the uint8 view keeps every native call
+            # zero-copy on the mask
+            base_mask = valid.view(np.uint8)
+
     def count_where(flags):
+        if native_mod is not None:
+            m = base_mask if flags is None else (
+                flags.view(np.uint8) if flags.dtype == np.bool_ else flags
+            )
+            return native_mod.groupby_i64(codes32, None, m, minlength)[1]
         if flags is None:  # all rows count
             return np.bincount(safe, minlength=minlength).astype(np.int64)
         return np.bincount(
@@ -710,6 +743,28 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
                 f"op {op!r} cannot aggregate a sentinel-null measure"
             )
         values = np.asarray(values)
+        if native_mod is not None and op in ("sum", "mean"):
+            # one striped kernel call yields sum AND presence count (the
+            # mean denominator) — and runs before any isnan/present
+            # bookkeeping, which the kernels handle internally
+            if np.issubdtype(values.dtype, np.floating):
+                fsums, fcounts = native_mod.groupby_f64(
+                    codes32, values, base_mask, minlength,
+                    want_counts=(op == "mean"),
+                )
+                partial = {"sum": fsums}
+                if op == "mean":
+                    partial["count"] = fcounts
+            else:
+                isums, icounts = native_mod.groupby_i64(
+                    codes32, values.astype(np.int64, copy=False),
+                    base_mask, minlength,
+                )
+                partial = {"sum": isums}
+                if op == "mean":
+                    partial["count"] = icounts
+            aggs.append(partial)
+            continue
         null = null_mask(values, sentinel)
         has_null = null.any() if (
             sentinel is not None

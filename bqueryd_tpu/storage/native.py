@@ -17,6 +17,7 @@ TPC_ZLIB = 2
 _lib = None
 _searched = False
 _has_blosc = False
+_has_groupby = False
 
 
 def _candidate_paths():
@@ -124,6 +125,24 @@ def get_lib():
             ctypes.c_void_p,
             ctypes.c_size_t,
         ]
+        global _has_groupby
+        try:
+            for name in ("tpc_groupby_i64", "tpc_groupby_f64"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int32
+                fn.argtypes = [
+                    ctypes.c_void_p,  # codes int32*
+                    ctypes.c_void_p,  # values (nullable)
+                    ctypes.c_void_p,  # mask uint8* (nullable)
+                    ctypes.c_size_t,  # n
+                    ctypes.c_int64,   # n_groups
+                    ctypes.c_void_p,  # sums (nullable for i64)
+                    ctypes.c_void_p,  # counts
+                    ctypes.c_int32,   # nthreads
+                ]
+            _has_groupby = True
+        except AttributeError:
+            _has_groupby = False
         _lib = lib
         break
     return _lib
@@ -217,3 +236,62 @@ def factorize_i64(values: np.ndarray):
     if nuniq < 0:
         raise RuntimeError("tpc_factorize_i64 capacity exceeded")
     return codes, uniques[:nuniq].copy()
+
+
+def groupby_available():
+    """True when the loaded lib carries the host groupby kernels (older
+    builds predate them; callers fall back to the numpy paths)."""
+    return get_lib() is not None and _has_groupby
+
+
+def groupby_i64(codes, values, mask, n_groups, nthreads=0):
+    """Per-group exact int64 sums (mod 2^64, any value magnitude) and counts.
+
+    codes: int32[n] (negative = excluded); values: int64[n] or None (counts
+    only); mask: bool[n] or None.  Returns (sums int64[n_groups] | None,
+    counts int64[n_groups])."""
+    lib = get_lib()
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    n = len(codes)
+    counts = np.empty(n_groups, dtype=np.int64)
+    sums = None
+    vptr = sptr = mptr = None
+    if values is not None:
+        values = np.ascontiguousarray(values, dtype=np.int64)
+        sums = np.empty(n_groups, dtype=np.uint64)
+        vptr, sptr = values.ctypes.data, sums.ctypes.data
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, dtype=np.uint8)
+        mptr = mask.ctypes.data
+    rc = lib.tpc_groupby_i64(
+        codes.ctypes.data, vptr, mptr, n, n_groups, sptr,
+        counts.ctypes.data, nthreads,
+    )
+    if rc != 0:
+        raise RuntimeError("tpc_groupby_i64 failed")
+    return (None if sums is None else sums.view(np.int64)), counts
+
+
+def groupby_f64(codes, values, mask, n_groups, nthreads=0, want_counts=True):
+    """Per-group float64 sums with NaN skip; counts = present (non-NaN) rows.
+
+    Thread-merge order is fixed, so results are deterministic for a given
+    thread count but not bit-identical to numpy's bincount order."""
+    lib = get_lib()
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    n = len(codes)
+    sums = np.empty(n_groups, dtype=np.float64)
+    counts = np.empty(n_groups, dtype=np.int64) if want_counts else None
+    mptr = None
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, dtype=np.uint8)
+        mptr = mask.ctypes.data
+    rc = lib.tpc_groupby_f64(
+        codes.ctypes.data, values.ctypes.data, mptr, n, n_groups,
+        sums.ctypes.data,
+        None if counts is None else counts.ctypes.data, nthreads,
+    )
+    if rc != 0:
+        raise RuntimeError("tpc_groupby_f64 failed")
+    return sums, counts

@@ -625,3 +625,134 @@ int64_t tpc_factorize_i64(const int64_t* src, size_t n, int32_t* codes,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Host groupby partials — native twin of the hot primitives in
+// bqueryd_tpu.ops.groupby.host_partial_tables (the latency-routed host path,
+// the role bquery's Cython kernels played at reference bqueryd/worker.py:313).
+// Rows stripe across threads with per-thread [n_groups] accumulators merged
+// at the end.  Int64 sums accumulate in uint64 (mod 2^64) so they are exact
+// for ANY value magnitude and any thread order — the numpy path's 2^53
+// float-bincount bound does not apply here.  A row contributes iff its code
+// is in [0, n_groups) and its mask byte (when a mask is given) is nonzero.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+int32_t plan_workers(size_t n, size_t n_groups, int32_t nthreads) {
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 1;
+  if (nthreads <= 0) nthreads = hw;
+  int32_t workers = std::max(1, std::min(nthreads, hw));
+  // below ~128k rows thread spawn overhead beats the striping win
+  if (n < (1u << 17)) workers = 1;
+  // each extra worker costs an O(G) zero + merge; keep that amortized by
+  // at least 8 G row-operations per worker or the accumulator bookkeeping
+  // dwarfs the row scan it parallelizes
+  const size_t by_groups = n / (8 * std::max<size_t>(n_groups, 1));
+  workers = std::min<int32_t>(
+      workers, static_cast<int32_t>(std::max<size_t>(by_groups, 1)));
+  return workers;
+}
+
+template <typename Body>
+void run_striped(size_t n, int32_t workers, const Body& body) {
+  if (workers == 1) {
+    body(0, 0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (int32_t t = 0; t < workers; ++t) {
+    size_t lo = n * static_cast<size_t>(t) / workers;
+    size_t hi = n * static_cast<size_t>(t + 1) / workers;
+    threads.emplace_back([&body, t, lo, hi] { body(t, lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// counts[g] = contributing rows; if values && sums: sums[g] += values mod
+// 2^64.  Returns 0, or -1 on a bad shape.
+int32_t tpc_groupby_i64(const int32_t* codes, const int64_t* values,
+                        const uint8_t* mask, size_t n, int64_t n_groups,
+                        uint64_t* sums, int64_t* counts, int32_t nthreads) {
+  if (n_groups <= 0 || !codes || !counts) return -1;
+  const size_t G = static_cast<size_t>(n_groups);
+  const bool want_sums = values != nullptr && sums != nullptr;
+  const int32_t workers = plan_workers(n, G, nthreads);
+  std::vector<std::vector<uint64_t>> tsums(workers);
+  std::vector<std::vector<int64_t>> tcounts(workers);
+  run_striped(n, workers, [&](int32_t t, size_t lo, size_t hi) {
+    auto& c = tcounts[t];
+    c.assign(G, 0);
+    uint64_t* s = nullptr;
+    if (want_sums) {
+      tsums[t].assign(G, 0);
+      s = tsums[t].data();
+    }
+    for (size_t i = lo; i < hi; ++i) {
+      const int32_t g = codes[i];
+      if (g < 0 || static_cast<int64_t>(g) >= n_groups) continue;
+      if (mask && !mask[i]) continue;
+      c[g] += 1;
+      if (s) s[g] += static_cast<uint64_t>(values[i]);
+    }
+  });
+  for (size_t g = 0; g < G; ++g) counts[g] = 0;
+  if (want_sums)
+    for (size_t g = 0; g < G; ++g) sums[g] = 0;
+  for (int32_t t = 0; t < workers; ++t) {
+    for (size_t g = 0; g < G; ++g) counts[g] += tcounts[t][g];
+    if (want_sums)
+      for (size_t g = 0; g < G; ++g) sums[g] += tsums[t][g];
+  }
+  return 0;
+}
+
+// f64 sums with NaN skip; counts[g] (when given) = PRESENT (non-NaN)
+// contributing rows.  Per-thread partials merge in worker order, so results
+// are deterministic for a fixed thread count (float addition is not
+// associative; bit-for-bit numpy equality is not promised, matching the
+// allclose contract of the float paths).
+int32_t tpc_groupby_f64(const int32_t* codes, const double* values,
+                        const uint8_t* mask, size_t n, int64_t n_groups,
+                        double* sums, int64_t* counts, int32_t nthreads) {
+  if (n_groups <= 0 || !codes || !values || !sums) return -1;
+  const size_t G = static_cast<size_t>(n_groups);
+  const int32_t workers = plan_workers(n, G, nthreads);
+  std::vector<std::vector<double>> tsums(workers);
+  std::vector<std::vector<int64_t>> tcounts(workers);
+  run_striped(n, workers, [&](int32_t t, size_t lo, size_t hi) {
+    auto& s = tsums[t];
+    s.assign(G, 0.0);
+    int64_t* c = nullptr;
+    if (counts) {
+      tcounts[t].assign(G, 0);
+      c = tcounts[t].data();
+    }
+    for (size_t i = lo; i < hi; ++i) {
+      const int32_t g = codes[i];
+      if (g < 0 || static_cast<int64_t>(g) >= n_groups) continue;
+      if (mask && !mask[i]) continue;
+      const double v = values[i];
+      if (v != v) continue;  // NaN = missing (pandas skipna)
+      s[g] += v;
+      if (c) c[g] += 1;
+    }
+  });
+  for (size_t g = 0; g < G; ++g) sums[g] = 0.0;
+  if (counts)
+    for (size_t g = 0; g < G; ++g) counts[g] = 0;
+  for (int32_t t = 0; t < workers; ++t) {
+    for (size_t g = 0; g < G; ++g) sums[g] += tsums[t][g];
+    if (counts)
+      for (size_t g = 0; g < G; ++g) counts[g] += tcounts[t][g];
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -664,13 +664,20 @@ def test_host_ns_estimate_routes_slow_measures(tmp_path):
     fast = qmod._HOST_NS_PER_ROW
     slow = qmod._HOST_NS_PER_ROW_SLOW
     est = qmod._host_ns_estimate
+    from bqueryd_tpu.storage import native as _native
+
     assert est(ct, [["small", "sum", "s"]], 1_000_000) == fast
     assert est(ct, [["f", "sum", "s"]], 1_000_000) == fast  # float: 1 bincount
     assert est(ct, [["small", "min", "s"]], 1_000) == slow  # ufunc.at
-    # 2^40 bound x 2^20 rows >= 2^53 -> limb fallback
-    assert est(ct, [["huge", "sum", "s"]], 1_048_576) == slow
+    # 2^40 bound x 150k rows >= 2^53 AND below the native row floor -> the
+    # numpy limb fallback would run: slow rate
+    assert est(ct, [["huge", "sum", "s"]], 150_000) == slow
     # same column, few rows -> partial sums stay exact, fast path
     assert est(ct, [["huge", "sum", "s"]], 1_000) == fast
+    # above the native floor the C++ kernel sums exactly at any magnitude,
+    # so the same huge-bound query rates fast (when the lib is built)
+    if _native.groupby_available():
+        assert est(ct, [["huge", "sum", "s"]], 1_048_576) == fast
     # the slow estimate shrinks the derived threshold proportionally
     # (conftest pins BQUERYD_TPU_HOST_KERNEL_ROWS=0 for determinism, so
     # lift it here to exercise the derived-threshold path)
@@ -682,3 +689,70 @@ def test_host_ns_estimate_routes_slow_measures(tmp_path):
         qmod._measured_floor = None
         if env_prior is not None:
             os.environ["BQUERYD_TPU_HOST_KERNEL_ROWS"] = env_prior
+
+
+def test_native_host_groupby_matches_numpy_paths(monkeypatch):
+    """The striped C++ host kernels must agree with the numpy paths exactly:
+    bit-equal int sums (any magnitude — the native path has no 2^53 bound),
+    equal counts, allclose float sums with identical NaN-skip counts."""
+    from bqueryd_tpu.storage import native
+
+    if not native.groupby_available():
+        pytest.skip("native groupby kernels not built")
+    m = _groupby_module()
+    rng = np.random.default_rng(48)
+    n, g = 300_000, 37
+    codes = rng.integers(-1, g, n).astype(np.int32)
+    mask = rng.random(n) < 0.9
+    ivals = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+    fvals = rng.random(n).astype(np.float64) * 100 - 50
+    fvals[rng.random(n) < 0.05] = np.nan
+
+    def run():
+        return gb.host_partial_tables(
+            codes,
+            (ivals, fvals, ivals, fvals),
+            ("sum", "mean", "count", "count_na"),
+            g,
+            mask=mask,
+        )
+
+    assert n >= m._NATIVE_GROUPBY_MIN_ROWS  # native path engages
+    native_out = run()
+    monkeypatch.setattr(m, "_NATIVE_GROUPBY_MIN_ROWS", n + 1)
+    numpy_out = run()
+
+    np.testing.assert_array_equal(native_out["rows"], numpy_out["rows"])
+    for ai, (na, npy) in enumerate(
+        zip(native_out["aggs"], numpy_out["aggs"])
+    ):
+        assert set(na) == set(npy), f"agg {ai} partial keys differ"
+        for key in na:
+            a, b = np.asarray(na[key]), np.asarray(npy[key])
+            if a.dtype.kind in "iu":
+                np.testing.assert_array_equal(a, b, err_msg=f"{ai}/{key}")
+            else:
+                np.testing.assert_allclose(
+                    a, b, rtol=1e-12, err_msg=f"{ai}/{key}"
+                )
+
+
+def test_native_host_groupby_no_mask_fast_case(monkeypatch):
+    """All-valid rows (mask=None, no negative codes) hit the native kernels
+    with a null mask pointer; results still match numpy."""
+    from bqueryd_tpu.storage import native
+
+    if not native.groupby_available():
+        pytest.skip("native groupby kernels not built")
+    m = _groupby_module()
+    rng = np.random.default_rng(49)
+    n, g = 250_000, 11
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.integers(-(2**40), 2**40, n).astype(np.int64)
+    native_out = gb.host_partial_tables(codes, (vals,), ("sum",), g)
+    monkeypatch.setattr(m, "_NATIVE_GROUPBY_MIN_ROWS", n + 1)
+    numpy_out = gb.host_partial_tables(codes, (vals,), ("sum",), g)
+    np.testing.assert_array_equal(native_out["rows"], numpy_out["rows"])
+    np.testing.assert_array_equal(
+        native_out["aggs"][0]["sum"], numpy_out["aggs"][0]["sum"]
+    )
