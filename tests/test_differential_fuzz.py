@@ -56,6 +56,8 @@ def _dataset(seed):
                         np.nan,
                         (rng.random(n) * 100 - 50),
                     ).astype(np.float32),
+                    "v_bool": rng.random(n) < 0.3,
+                    "v_u32": rng.integers(0, 2**32, n).astype(np.uint32),
                     "sel": rng.random(n).astype(np.float64),
                 }
             )
@@ -105,6 +107,18 @@ CASES = [
     # only — count_distinct partials are value sets, not psum-mergeable
     (["k_int"], [["v_float", "count_distinct", "nd"]], []),
     (["k_str"], [["t", "count_distinct", "nt"]], []),
+    # remaining measure dtypes: bool sums count trues, unsigned sums stay
+    # exact through the limb/native paths
+    (["k_int"], [["v_bool", "sum", "s"], ["v_bool", "mean", "m"]], []),
+    (["k_int"], [["v_u32", "sum", "s"], ["v_u32", "max", "hi"]], []),
+    # equality predicates, incl. on a dict column and a datetime bound
+    (["k_int"], [["v_small", "sum", "s"]], [["k_str", "==", "b"]]),
+    (["k_str"], [["v_small", "sum", "s"]], [["k_int", "!=", 3]]),
+    (
+        ["k_int"],
+        [["v_small", "count", "n"]],
+        [["t", ">", pd.Timestamp("2015-01-01")]],
+    ),
 ]
 
 
@@ -114,6 +128,10 @@ def _filter_df(df, where):
             df = df[df[col] > val]
         elif op == "<=":
             df = df[df[col] <= val]
+        elif op == "==":
+            df = df[df[col] == val]
+        elif op == "!=":
+            df = df[df[col] != val]
         elif op == "in":
             df = df[df[col].isin(val)]
         elif op == "not in":
