@@ -27,6 +27,8 @@ import json
 import os
 import pickle
 import random
+import signal
+import threading
 import time
 
 import zmq
@@ -113,6 +115,7 @@ class ControllerNode:
         self.msg_count_in = 0
         self.start_time = time.time()
         self.running = False
+        self._loop_thread = None
         self.last_heartbeat = 0.0
 
         self.runfile_dir = runfile_dir
@@ -145,6 +148,15 @@ class ControllerNode:
     # -- main loop ---------------------------------------------------------
     def go(self):
         self.running = True
+        self._loop_thread = threading.current_thread()
+        try:
+            # graceful supervisord stop: deregister from the store and
+            # remove runfiles instead of dying mid-dispatch (the worker
+            # installs the same handler; reference nodes relied on process
+            # teardown alone)
+            signal.signal(signal.SIGTERM, self._term_signal)
+        except ValueError:
+            pass  # not the main thread (in-process test clusters)
         self.logger.info("controller %s running", self.address)
         try:
             while self.running:
@@ -167,14 +179,31 @@ class ControllerNode:
         finally:
             self.stop()
 
+    def _term_signal(self, *args):
+        self.logger.info("SIGTERM received, stopping")
+        self.running = False
+
     def stop(self):
+        # doubles as a cross-thread shutdown REQUEST (see WorkerBase.stop):
+        # an external caller only flags the loop — the loop thread re-enters
+        # here on exit for the store/socket teardown (zmq sockets are
+        # single-thread-only)
+        self.running = False
+        loop = self._loop_thread
+        if (
+            loop is not None
+            and loop.is_alive()
+            and threading.current_thread() is not loop
+        ):
+            return
         try:
             self.store.srem(bqueryd_tpu.REDIS_SET_KEY, self.address)
         except Exception:
             pass
         self._remove_runfiles()
-        self.socket.close()
-        self.logger.info("controller %s stopped", self.address)
+        if not self.socket.closed:
+            self.socket.close()
+            self.logger.info("controller %s stopped", self.address)
 
     # -- membership --------------------------------------------------------
     def heartbeat(self):

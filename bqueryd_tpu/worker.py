@@ -113,10 +113,12 @@ class WorkerBase:
         self.last_heartbeat = 0.0
         self._hb_thread = None
         self._hb_stop = threading.Event()
+        self._loop_thread = None
 
     # -- lifecycle ---------------------------------------------------------
     def go(self):
         self.running = True
+        self._loop_thread = threading.current_thread()
         try:
             signal.signal(signal.SIGTERM, self._term_signal)
         except ValueError:
@@ -141,17 +143,39 @@ class WorkerBase:
         self.logger.info("SIGTERM received, stopping")
         self.running = False
 
-    def stop(self):
+    def _request_stop_only(self):
+        """Flag the loop to exit.  Returns True when the caller is NOT the
+        loop thread while the loop is alive — zmq sockets are
+        single-thread-only, so socket teardown must then be left to the
+        loop thread's own exit path (go()'s trailing stop())."""
+        self.running = False
         self._hb_stop.set()
-        if self._hb_thread is not None:
+        loop = self._loop_thread
+        external = (
+            loop is not None
+            and loop.is_alive()
+            and threading.current_thread() is not loop
+        )
+        if not external and self._hb_thread is not None and (
+            self._hb_thread.ident is not None  # racing go(): not yet started
+        ):
             self._hb_thread.join(timeout=2.0)
+        return external
+
+    def stop(self):
+        # doubles as a cross-thread shutdown REQUEST (tests, embedders):
+        # the flag ends the loop and the loop thread re-enters here for the
+        # actual socket teardown
+        if self._request_stop_only():
+            return
         for addr in list(self.controllers):
             try:
                 self.send(addr, StopMessage({"worker_id": self.worker_id}))
             except zmq.ZMQError:
                 pass
-        self.socket.close()
-        self.logger.info("worker %s stopped", self.worker_id)
+        if not self.socket.closed:
+            self.socket.close()
+            self.logger.info("worker %s stopped", self.worker_id)
 
     # -- liveness side-channel --------------------------------------------
     def _start_heartbeat_thread(self):
@@ -778,6 +802,8 @@ class DownloaderNode(WorkerBase):
             self.send_to_all(msg)
 
     def stop(self):
+        if self._request_stop_only():
+            return  # outbox/socket teardown belongs to the loop thread
         if self._download_pool is not None:
             self._download_pool.shutdown(wait=False, cancel_futures=True)
         self._drain_outbox()

@@ -624,3 +624,62 @@ def test_hb_only_adoption_expires_after_hard_timeout(mem_store_url):
         assert controller.worker_map["w1"]["last_seen"]
     finally:
         controller.socket.close()
+
+
+def test_stop_is_a_shutdown_request_and_deregisters(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """Calling stop() from OUTSIDE the node loop (tests, embedders,
+    signal handlers) must end the loop promptly and deregister the
+    controller from the coordination store — previously the loop kept
+    polling the closed socket forever and external teardown hung on
+    thread joins."""
+    import logging
+    import threading
+    import time
+
+    import bqueryd_tpu
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.coordination import coordination_store
+    from bqueryd_tpu.worker import WorkerNode
+
+    monkeypatch.setenv("BQUERYD_TPU_WARMUP", "0")
+    url = mem_store_url
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.1,
+    )
+    worker = WorkerNode(
+        coordination_url=url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    threads = [
+        threading.Thread(target=n.go, daemon=True)
+        for n in (controller, worker)
+    ]
+    for t in threads:
+        t.start()
+    store = coordination_store(url)
+    wait_until(
+        lambda: store.smembers(bqueryd_tpu.REDIS_SET_KEY),
+        desc="controller registration",
+    )
+    # stop() before go() starts is a different race; wait the loops in
+    wait_until(
+        lambda: controller.running and worker.running, desc="loops running"
+    )
+
+    t0 = time.time()
+    worker.stop()
+    controller.stop()
+    for t in threads:
+        t.join(timeout=5)
+    assert not any(t.is_alive() for t in threads), "node loops did not exit"
+    assert time.time() - t0 < 5, "external stop() took too long"
+    assert store.smembers(bqueryd_tpu.REDIS_SET_KEY) == set()

@@ -289,3 +289,83 @@ def test_concurrent_clients_all_get_correct_results(tmp_path, mem_store_url):
             n.running = False
         for t in threads:
             t.join(timeout=5)
+
+
+def test_wide_fanout_64_shards_two_workers(tmp_path, mem_store_url):
+    from tests.conftest import wait_until
+
+    """Scale check on the fan-out machinery: 64 shards served by 2 workers
+    through one query must batch into shard groups, keep the sink's
+    bookkeeping straight, and produce the pandas answer — the widest
+    shard count in the suite (the bench uses 10)."""
+    import logging
+    import os
+    import threading
+    import time
+
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    rng = np.random.default_rng(11)
+    frames = []
+    for i in range(64):
+        df = pd.DataFrame(
+            {
+                "g": rng.integers(0, 9, 500).astype(np.int64),
+                "v": rng.integers(-(2**45), 2**45, 500).astype(np.int64),
+            }
+        )
+        frames.append(df)
+        ctable.fromdataframe(df, str(tmp_path / f"w_{i:02d}.bcolzs"))
+    names = [f"w_{i:02d}.bcolzs" for i in range(64)]
+
+    url = mem_store_url
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.2,
+    )
+    workers = [
+        WorkerNode(
+            coordination_url=url,
+            data_dir=str(tmp_path),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.2,
+            poll_timeout=0.05,
+        )
+        for _ in range(2)
+    ]
+    threads = [
+        threading.Thread(target=n.go, daemon=True)
+        for n in [controller] + workers
+    ]
+    for t in threads:
+        t.start()
+    try:
+        wait_until(
+            lambda: len(controller.files_map) >= 64,
+            timeout=60,
+            desc="64 shards registered",
+        )
+        rpc = RPC(
+            coordination_url=url, timeout=120, loglevel=logging.WARNING
+        )
+        got = rpc.groupby(names, ["g"], [["v", "sum", "s"]], [])
+        got = got.sort_values("g").reset_index(drop=True)
+        expected = (
+            pd.concat(frames).groupby("g")["v"].sum().reset_index(name="s")
+        )
+        assert got["g"].tolist() == expected["g"].tolist()
+        assert got["s"].tolist() == expected["s"].tolist()
+    finally:
+        for n in [controller] + workers:
+            n.stop()
+        for t in threads:
+            t.join(timeout=10)
