@@ -264,27 +264,34 @@ def _host_ns_estimate(table, agg_list, n_rows):
         HOST_EXACT_SUM_BOUND,
     )
 
-    native_sums = None  # computed lazily: import + symbol probe
+    native_ok = None  # computed lazily: import + symbol probe
+
+    def native_takes_it():
+        # The C++ kernels sum in uint64 (exact at any magnitude) and do
+        # min/max in one striped pass, so queries they will take have no
+        # slow fallback to price in.  (They decline above their group
+        # ceiling — unknown until factorize — in which case the numpy
+        # path runs mis-rated; high-cardinality host routes are rare
+        # enough to accept that.)
+        nonlocal native_ok
+        if native_ok is None:
+            from bqueryd_tpu.storage import native
+
+            native_ok = (
+                n_rows >= _NATIVE_GROUPBY_MIN_ROWS
+                and native.groupby_available()
+            )
+        return native_ok
+
     for in_col, op, _out in agg_list:
         if op in ("min", "max"):
-            return _HOST_NS_PER_ROW_SLOW
+            if table.kind(in_col) == "datetime" or not native_takes_it():
+                return _HOST_NS_PER_ROW_SLOW  # numpy ufunc.at extrema
+            continue
         if op in ("sum", "mean") and np.issubdtype(
             table.physical_dtype(in_col), np.integer
         ):
-            if native_sums is None:
-                from bqueryd_tpu.storage import native
-
-                # the C++ kernel accumulates int sums in uint64 (exact at
-                # any magnitude), so queries it will take have no slow
-                # fallback to price in.  (It declines above its group
-                # ceiling — unknown until factorize — in which case the
-                # numpy limb path runs mis-rated; high-cardinality host
-                # routes are rare enough to accept that.)
-                native_sums = (
-                    n_rows >= _NATIVE_GROUPBY_MIN_ROWS
-                    and native.groupby_available()
-                )
-            if native_sums:
+            if native_takes_it():
                 continue
             stats = table.col_stats(in_col)
             if stats is None:

@@ -732,6 +732,7 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
 
     rows = count_where(None if all_valid else valid)
     sentinels = _normalize_sentinels(null_sentinels, len(measures))
+    _minmax_cache = {}  # (values id, dtype) -> (mins, maxs, counts)
     aggs = []
     for values, op, sentinel in zip(measures, ops, sentinels):
         if op not in MERGEABLE_OPS:
@@ -743,6 +744,39 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
                 f"op {op!r} cannot aggregate a sentinel-null measure"
             )
         values = np.asarray(values)
+        if (
+            native_mod is not None
+            and sentinel is None
+            and op in ("min", "max")
+            and native_mod.groupby_minmax_available()
+            # unsigned values >= 2^63 would wrap in the signed i64 kernel
+            # (and uint64's identity fill overflows int64): numpy path
+            and not np.issubdtype(values.dtype, np.unsignedinteger)
+        ):
+            # one striped pass yields min+max+present counts; empty groups
+            # re-filled with the MEASURE dtype's identity after the int64/f64
+            # kernel so cross-shard merges stay correct post-cast.  min and
+            # max of the SAME measure share the pass via the cache.
+            cache_key = (id(values), values.dtype.str)
+            hit = _minmax_cache.get(cache_key)
+            if hit is None:
+                hit = native_mod.groupby_minmax(
+                    codes32, values, base_mask, minlength
+                )
+                _minmax_cache[cache_key] = hit
+            mns, mxs, cnts = hit
+            ext64 = mns if op == "min" else mxs
+            target = values.dtype
+            if np.issubdtype(target, np.floating):
+                fill = np.inf if op == "min" else -np.inf
+            else:
+                fill = (
+                    np.iinfo(target).max if op == "min"
+                    else np.iinfo(target).min
+                )
+            ext = np.where(cnts == 0, fill, ext64).astype(target)
+            aggs.append({op: ext, "count": cnts})
+            continue
         if native_mod is not None and op in ("sum", "mean"):
             # one striped kernel call yields sum AND presence count (the
             # mean denominator) — and runs before any isnan/present

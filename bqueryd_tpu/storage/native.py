@@ -18,6 +18,7 @@ _lib = None
 _searched = False
 _has_blosc = False
 _has_groupby = False
+_has_groupby_minmax = False
 
 
 def _candidate_paths():
@@ -125,7 +126,27 @@ def get_lib():
             ctypes.c_void_p,
             ctypes.c_size_t,
         ]
-        global _has_groupby
+        global _has_groupby, _has_groupby_minmax
+        # separate probes: a stale prebuilt .so may carry the sum kernels
+        # but predate the minmax ones — the older capability must survive
+        try:
+            for name in ("tpc_groupby_minmax_i64", "tpc_groupby_minmax_f64"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int32
+                fn.argtypes = [
+                    ctypes.c_void_p,  # codes int32*
+                    ctypes.c_void_p,  # values
+                    ctypes.c_void_p,  # mask uint8* (nullable)
+                    ctypes.c_size_t,  # n
+                    ctypes.c_int64,   # n_groups
+                    ctypes.c_void_p,  # mins
+                    ctypes.c_void_p,  # maxs
+                    ctypes.c_void_p,  # counts
+                    ctypes.c_int32,   # nthreads
+                ]
+            _has_groupby_minmax = True
+        except AttributeError:
+            _has_groupby_minmax = False
         try:
             for name in ("tpc_groupby_i64", "tpc_groupby_f64"):
                 fn = getattr(lib, name)
@@ -239,9 +260,14 @@ def factorize_i64(values: np.ndarray):
 
 
 def groupby_available():
-    """True when the loaded lib carries the host groupby kernels (older
-    builds predate them; callers fall back to the numpy paths)."""
+    """True when the loaded lib carries the host groupby sum/count kernels
+    (older builds predate them; callers fall back to the numpy paths)."""
     return get_lib() is not None and _has_groupby
+
+
+def groupby_minmax_available():
+    """True when the loaded lib also carries the min/max kernels."""
+    return get_lib() is not None and _has_groupby_minmax
 
 
 def groupby_i64(codes, values, mask, n_groups, nthreads=0):
@@ -295,3 +321,39 @@ def groupby_f64(codes, values, mask, n_groups, nthreads=0, want_counts=True):
     if rc != 0:
         raise RuntimeError("tpc_groupby_f64 failed")
     return sums, counts
+
+
+def groupby_minmax(codes, values, mask, n_groups, nthreads=0):
+    """Per-group (min, max, present_count) in one striped pass.
+
+    int64 values take the i64 kernel; floats go through the f64 kernel
+    (NaN rows skipped).  Empty groups report the identity fills (int64
+    max/min or +/-inf) with count 0, the same convention the numpy and
+    device paths use."""
+    lib = get_lib()
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    n = len(codes)
+    counts = np.empty(n_groups, dtype=np.int64)
+    mptr = None
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, dtype=np.uint8)
+        mptr = mask.ctypes.data
+    if np.issubdtype(np.asarray(values).dtype, np.floating):
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        mins = np.empty(n_groups, dtype=np.float64)
+        maxs = np.empty(n_groups, dtype=np.float64)
+        rc = lib.tpc_groupby_minmax_f64(
+            codes.ctypes.data, values.ctypes.data, mptr, n, n_groups,
+            mins.ctypes.data, maxs.ctypes.data, counts.ctypes.data, nthreads,
+        )
+    else:
+        values = np.ascontiguousarray(values, dtype=np.int64)
+        mins = np.empty(n_groups, dtype=np.int64)
+        maxs = np.empty(n_groups, dtype=np.int64)
+        rc = lib.tpc_groupby_minmax_i64(
+            codes.ctypes.data, values.ctypes.data, mptr, n, n_groups,
+            mins.ctypes.data, maxs.ctypes.data, counts.ctypes.data, nthreads,
+        )
+    if rc != 0:
+        raise RuntimeError("tpc_groupby_minmax failed")
+    return mins, maxs, counts

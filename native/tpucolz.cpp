@@ -21,6 +21,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <thread>
 #include <vector>
 
@@ -709,6 +710,98 @@ int32_t tpc_groupby_i64(const int32_t* codes, const int64_t* values,
     for (size_t g = 0; g < G; ++g) counts[g] += tcounts[t][g];
     if (want_sums)
       for (size_t g = 0; g < G; ++g) sums[g] += tsums[t][g];
+  }
+  return 0;
+}
+
+// Per-group min+max+presence counts in one pass.  Int64 flavor: every
+// contributing row participates; f64 flavor additionally skips NaN.  Empty
+// groups report the identity fills (int64 max/min, +/-inf) and count 0 —
+// the same convention as the numpy/device paths, masked by count upstream.
+int32_t tpc_groupby_minmax_i64(const int32_t* codes, const int64_t* values,
+                               const uint8_t* mask, size_t n,
+                               int64_t n_groups, int64_t* mins,
+                               int64_t* maxs, int64_t* counts,
+                               int32_t nthreads) {
+  if (n_groups <= 0 || !codes || !values || !mins || !maxs || !counts)
+    return -1;
+  const size_t G = static_cast<size_t>(n_groups);
+  const int64_t kMin = INT64_MIN, kMax = INT64_MAX;
+  const int32_t workers = plan_workers(n, G, nthreads);
+  std::vector<std::vector<int64_t>> tmins(workers), tmaxs(workers);
+  std::vector<std::vector<int64_t>> tcounts(workers);
+  run_striped(n, workers, [&](int32_t t, size_t lo, size_t hi) {
+    tmins[t].assign(G, kMax);
+    tmaxs[t].assign(G, kMin);
+    tcounts[t].assign(G, 0);
+    int64_t* mn = tmins[t].data();
+    int64_t* mx = tmaxs[t].data();
+    int64_t* c = tcounts[t].data();
+    for (size_t i = lo; i < hi; ++i) {
+      const int32_t g = codes[i];
+      if (g < 0 || static_cast<int64_t>(g) >= n_groups) continue;
+      if (mask && !mask[i]) continue;
+      const int64_t v = values[i];
+      if (v < mn[g]) mn[g] = v;
+      if (v > mx[g]) mx[g] = v;
+      c[g] += 1;
+    }
+  });
+  for (size_t g = 0; g < G; ++g) {
+    mins[g] = kMax;
+    maxs[g] = kMin;
+    counts[g] = 0;
+  }
+  for (int32_t t = 0; t < workers; ++t) {
+    for (size_t g = 0; g < G; ++g) {
+      if (tmins[t][g] < mins[g]) mins[g] = tmins[t][g];
+      if (tmaxs[t][g] > maxs[g]) maxs[g] = tmaxs[t][g];
+      counts[g] += tcounts[t][g];
+    }
+  }
+  return 0;
+}
+
+int32_t tpc_groupby_minmax_f64(const int32_t* codes, const double* values,
+                               const uint8_t* mask, size_t n,
+                               int64_t n_groups, double* mins, double* maxs,
+                               int64_t* counts, int32_t nthreads) {
+  if (n_groups <= 0 || !codes || !values || !mins || !maxs || !counts)
+    return -1;
+  const size_t G = static_cast<size_t>(n_groups);
+  const double kInf = std::numeric_limits<double>::infinity();
+  const int32_t workers = plan_workers(n, G, nthreads);
+  std::vector<std::vector<double>> tmins(workers), tmaxs(workers);
+  std::vector<std::vector<int64_t>> tcounts(workers);
+  run_striped(n, workers, [&](int32_t t, size_t lo, size_t hi) {
+    tmins[t].assign(G, kInf);
+    tmaxs[t].assign(G, -kInf);
+    tcounts[t].assign(G, 0);
+    double* mn = tmins[t].data();
+    double* mx = tmaxs[t].data();
+    int64_t* c = tcounts[t].data();
+    for (size_t i = lo; i < hi; ++i) {
+      const int32_t g = codes[i];
+      if (g < 0 || static_cast<int64_t>(g) >= n_groups) continue;
+      if (mask && !mask[i]) continue;
+      const double v = values[i];
+      if (v != v) continue;  // NaN = missing
+      if (v < mn[g]) mn[g] = v;
+      if (v > mx[g]) mx[g] = v;
+      c[g] += 1;
+    }
+  });
+  for (size_t g = 0; g < G; ++g) {
+    mins[g] = kInf;
+    maxs[g] = -kInf;
+    counts[g] = 0;
+  }
+  for (int32_t t = 0; t < workers; ++t) {
+    for (size_t g = 0; g < G; ++g) {
+      if (tmins[t][g] < mins[g]) mins[g] = tmins[t][g];
+      if (tmaxs[t][g] > maxs[g]) maxs[g] = tmaxs[t][g];
+      counts[g] += tcounts[t][g];
+    }
   }
   return 0;
 }

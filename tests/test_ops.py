@@ -711,8 +711,8 @@ def test_native_host_groupby_matches_numpy_paths(monkeypatch):
     def run():
         return gb.host_partial_tables(
             codes,
-            (ivals, fvals, ivals, fvals),
-            ("sum", "mean", "count", "count_na"),
+            (ivals, fvals, ivals, fvals, ivals, fvals),
+            ("sum", "mean", "count", "count_na", "min", "max"),
             g,
             mask=mask,
         )
@@ -756,3 +756,46 @@ def test_native_host_groupby_no_mask_fast_case(monkeypatch):
     np.testing.assert_array_equal(
         native_out["aggs"][0]["sum"], numpy_out["aggs"][0]["sum"]
     )
+
+
+def test_native_minmax_unsigned_stays_on_numpy_path():
+    """uint64 values above 2^63 would wrap in the signed i64 minmax kernel,
+    so unsigned measures must keep the numpy ufunc.at path — results must
+    stay correct at native-route row counts."""
+    rng = np.random.default_rng(50)
+    n, g = 250_000, 7
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.integers(2**62, 2**64 - 1, n, dtype=np.uint64)
+    out = gb.host_partial_tables(
+        codes, (vals, vals), ("min", "max"), g
+    )
+    for gi in range(g):
+        sel = codes == gi
+        assert int(out["aggs"][0]["min"][gi]) == int(vals[sel].min()), gi
+        assert int(out["aggs"][1]["max"][gi]) == int(vals[sel].max()), gi
+
+
+def test_native_minmax_shares_one_pass(monkeypatch):
+    """min and max over the SAME measure must issue one native kernel call."""
+    from bqueryd_tpu.storage import native
+
+    if not native.groupby_minmax_available():
+        pytest.skip("native minmax kernels not built")
+    calls = []
+    real = native.groupby_minmax
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(native, "groupby_minmax", spy)
+    rng = np.random.default_rng(51)
+    n, g = 250_000, 5
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    out = gb.host_partial_tables(codes, (vals, vals), ("min", "max"), g)
+    assert len(calls) == 1, f"expected one shared pass, saw {len(calls)}"
+    for gi in range(g):
+        sel = codes == gi
+        assert int(out["aggs"][0]["min"][gi]) == vals[sel].min()
+        assert int(out["aggs"][1]["max"][gi]) == vals[sel].max()
