@@ -52,6 +52,21 @@ AGG_OPS = (
 MERGEABLE_OPS = ("sum", "mean", "count", "count_na", "min", "max")
 
 
+def extremum_fill(dtype, kind):
+    """Identity fill for per-group ``min``/``max`` partials of ``dtype``:
+    'min' fills with the dtype's maximum so any real value wins (and vice
+    versa); bool uses its and/or identities, floats +/-inf.  Shared by the
+    device kernels, the host kernels, and the cross-payload merge so a new
+    dtype special case lives in exactly one place."""
+    dtype = np.dtype(dtype)
+    if dtype.kind == "f":
+        return np.inf if kind == "min" else -np.inf
+    if dtype == np.bool_:
+        return kind == "min"
+    info = np.iinfo(dtype)
+    return info.max if kind == "min" else info.min
+
+
 def freeze_value(value):
     """Canonical, hashable, collision-free form of a query parameter
     (repr() is ambiguous for numpy arrays, which truncate their repr)."""
@@ -339,6 +354,18 @@ def host_kernel_rows(ns_per_row=None):
             return 0
     ns = _HOST_NS_PER_ROW if ns_per_row is None else ns_per_row
     return min(int(device_dispatch_floor() / ns), _HOST_ROUTE_CAP)
+
+
+def _value_kind_for(table, col):
+    """Storage-kind tag carried per agg in the payload: 'datetime' restores
+    datetime64 at finalize; 'uint64' re-views mod-2^64 sums as unsigned
+    (every kernel path accumulates the same bits either way — only the
+    presentation differs, matching pandas' uint64 groupby sums)."""
+    if table.kind(col) == "datetime":
+        return "datetime"
+    if table.physical_dtype(col) == np.dtype(np.uint64):
+        return "uint64"
+    return None
 
 
 class QueryEngine:
@@ -659,10 +686,8 @@ class QueryEngine:
                 aggs=aggs,
                 ops=query.ops,
                 out_cols=query.out_cols,
-                value_kinds=[
-                    "datetime" if table.kind(a[0]) == "datetime" else None
-                    for a in query.agg_list
-                ],
+                value_kinds=[_value_kind_for(table, a[0])
+                             for a in query.agg_list],
             )
 
     def _raw_rows(self, table, query, mask):

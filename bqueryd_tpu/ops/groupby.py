@@ -38,6 +38,7 @@ count_distinct, sorted_count_distinct) plus min/max.
 
 import functools
 import os
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +46,11 @@ from jax import lax
 
 # canonical definitions live JAX-free in models.query (the controller needs
 # them to decide shard batching without importing jax); re-exported here
-from bqueryd_tpu.models.query import AGG_OPS, MERGEABLE_OPS  # noqa: F401
+from bqueryd_tpu.models.query import (  # noqa: F401
+    AGG_OPS,
+    MERGEABLE_OPS,
+    extremum_fill,
+)
 
 
 def _accum_dtype(dtype):
@@ -311,14 +316,17 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
 def _segment_extremum(kind, values, present, safe, n_groups):
     """Per-group min/max via segment scatter; absent rows carry the identity
     fill so they never win (empty groups are masked later by count==0)."""
-    floating = jnp.issubdtype(values.dtype, jnp.floating)
-    if kind == "min":
-        fill = jnp.inf if floating else jnp.iinfo(values.dtype).max
-        return jax.ops.segment_min(
-            jnp.where(present, values, fill), safe, num_segments=n_groups
+    if values.dtype == jnp.bool_:
+        # bool has no iinfo; reduce as uint8 and view back
+        ext = _segment_extremum(
+            kind, values.astype(jnp.uint8), present, safe, n_groups
         )
-    fill = -jnp.inf if floating else jnp.iinfo(values.dtype).min
-    return jax.ops.segment_max(
+        return ext.astype(jnp.bool_)
+    # typed scalar, not a python int: uint64's max overflows the weak int64
+    # a bare literal would trace as
+    fill = np.dtype(values.dtype).type(extremum_fill(values.dtype, kind))
+    seg = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+    return seg(
         jnp.where(present, values, fill), safe, num_segments=n_groups
     )
 
@@ -407,7 +415,13 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         else:
             present_row = add_int((valid & ~null).astype(jnp.bfloat16))
         if op in ("sum", "mean"):
-            if not is_float:
+            if not is_float and op == "mean":
+                # pandas float-mean semantics (see the scatter path)
+                plans.append(
+                    ("f64_scatter", op, values.astype(jnp.float64),
+                     present_row)
+                )
+            elif not is_float:
                 v = values
                 if v.dtype == jnp.bool_:
                     v = v.astype(jnp.uint8)
@@ -525,9 +539,17 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
             _, _, values, present_row = plan
             present = valid & ~_null_mask(values)
             contrib = jnp.where(present, values, 0).astype(jnp.float64)
-            partial = {
-                "sum": jax.ops.segment_sum(contrib, safe, num_segments=n_groups)
-            }
+            if jax.default_backend() != "cpu":
+                # no native f64 on TPU: sort+prefix-diff beats the
+                # emulated-f64 scatter (same choice as the scatter path)
+                s = _sorted_segment_sum(
+                    contrib, safe, n_groups, acc_dtype=jnp.float64
+                )
+            else:
+                s = jax.ops.segment_sum(
+                    contrib, safe, num_segments=n_groups
+                )
+            partial = {"sum": s}
             if op == "mean":
                 partial["count"] = int_row(present_row).astype(jnp.int64)
             aggs.append(partial)
@@ -584,10 +606,15 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
             return rows if null is None else int_count(present)
 
         if op in ("sum", "mean"):
-            if floating:
-                contrib = jnp.where(present, values, 0).astype(
-                    _accum_dtype(values.dtype)
+            if floating or op == "mean":
+                # integer MEANS also accumulate in float like pandas: an
+                # exact mod-2^64 int sum divided by count diverges once the
+                # group sum wraps past 2^63, which float accumulation never
+                # does (sum stays bit-exact int — only mean floats)
+                acc = _accum_dtype(
+                    values.dtype if floating else jnp.float64
                 )
+                contrib = jnp.where(present, values, 0).astype(acc)
                 if (
                     contrib.dtype == jnp.float64
                     and jax.default_backend() != "cpu"
@@ -767,36 +794,31 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
             mns, mxs, cnts = hit
             ext64 = mns if op == "min" else mxs
             target = values.dtype
-            if np.issubdtype(target, np.floating):
-                fill = np.inf if op == "min" else -np.inf
-            else:
-                fill = (
-                    np.iinfo(target).max if op == "min"
-                    else np.iinfo(target).min
-                )
-            ext = np.where(cnts == 0, fill, ext64).astype(target)
+            ext = np.where(
+                cnts == 0, extremum_fill(target, op), ext64
+            ).astype(target)
             aggs.append({op: ext, "count": cnts})
             continue
         if native_mod is not None and op in ("sum", "mean"):
             # one striped kernel call yields sum AND presence count (the
             # mean denominator) — and runs before any isnan/present
-            # bookkeeping, which the kernels handle internally
-            if np.issubdtype(values.dtype, np.floating):
+            # bookkeeping, which the kernels handle internally.  Integer
+            # MEANS go through the f64 kernel (pandas float-mean semantics,
+            # see the scatter path).
+            if np.issubdtype(values.dtype, np.floating) or op == "mean":
                 fsums, fcounts = native_mod.groupby_f64(
-                    codes32, values, base_mask, minlength,
-                    want_counts=(op == "mean"),
+                    codes32, np.asarray(values, dtype=np.float64),
+                    base_mask, minlength, want_counts=(op == "mean"),
                 )
                 partial = {"sum": fsums}
                 if op == "mean":
                     partial["count"] = fcounts
             else:
-                isums, icounts = native_mod.groupby_i64(
+                isums, _ = native_mod.groupby_i64(
                     codes32, values.astype(np.int64, copy=False),
                     base_mask, minlength,
                 )
                 partial = {"sum": isums}
-                if op == "mean":
-                    partial["count"] = icounts
             aggs.append(partial)
             continue
         null = null_mask(values, sentinel)
@@ -807,7 +829,9 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
         # present=None means "every row contributes" — the fast paths above
         present = None if (all_valid and not has_null) else (valid & ~null)
         if op in ("sum", "mean"):
-            if np.issubdtype(values.dtype, np.floating):
+            if np.issubdtype(values.dtype, np.floating) or op == "mean":
+                # integer means accumulate in f64 like pandas (wrapped
+                # mod-2^64 int sums would corrupt the mean past 2^63)
                 contrib = (
                     values if present is None else np.where(present, values, 0)
                 ).astype(np.float64)
@@ -831,15 +855,14 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
             )
             aggs.append({"count": na})
         elif op in ("min", "max"):
-            floating = np.issubdtype(values.dtype, np.floating)
             sel = slice(None) if present is None else present
+            ext = np.full(
+                minlength, extremum_fill(values.dtype, op),
+                dtype=values.dtype,
+            )
             if op == "min":
-                fill = np.inf if floating else np.iinfo(values.dtype).max
-                ext = np.full(minlength, fill, dtype=values.dtype)
                 np.minimum.at(ext, safe[sel], values[sel])
             else:
-                fill = -np.inf if floating else np.iinfo(values.dtype).min
-                ext = np.full(minlength, fill, dtype=values.dtype)
                 np.maximum.at(ext, safe[sel], values[sel])
             aggs.append({op: ext, "count": count_where(present)})
     return {"rows": rows, "aggs": tuple(aggs)}

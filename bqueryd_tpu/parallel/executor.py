@@ -116,8 +116,10 @@ def _stored_dtype(tables, col):
 
 
 def _measure_kind(tables, col):
-    """'datetime' when every shard stores ``col`` as a datetime, None for
-    plain numeric/dict; mixed storage kinds across shards are a data error."""
+    """'datetime' when every shard stores ``col`` as a datetime, 'uint64'
+    when every shard stores it unsigned-64 (mod-2^64 sums re-view as
+    unsigned at finalize, pandas semantics), None for other numeric/dict;
+    mixed datetime/non-datetime storage across shards is a data error."""
     kinds = {t.kind(col) for t in tables}
     if kinds == {"datetime"}:
         return "datetime"
@@ -125,6 +127,12 @@ def _measure_kind(tables, col):
         raise ValueError(
             f"column {col!r} is datetime on some shards but not others"
         )
+    dtypes = [t.physical_dtype(col) for t in tables]
+    # the measures themselves widen via result_type (_stored_dtype), so the
+    # unsigned tag must follow the WIDENED dtype: u64+u32 shards accumulate
+    # in uint64 and their mod-2^64 sums still need the unsigned view
+    if dtypes and np.result_type(*dtypes) == np.dtype(np.uint64):
+        return "uint64"
     return None
 
 

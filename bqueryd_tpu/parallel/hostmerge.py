@@ -24,6 +24,8 @@ per SURVEY.md §7.4:
 
 import numpy as np
 
+from bqueryd_tpu.models.query import extremum_fill
+
 _MERGE_RULES = {
     "sum": np.add,
     "count": np.add,
@@ -160,15 +162,34 @@ def _merge_partials(payloads):
     key_cols = first["key_cols"]
     ops = first["ops"]
     out_cols = first["out_cols"]
+    def _merge_kinds(a, b):
+        # shards may store the same column at different widths: a uint64
+        # shard tags 'uint64' while a narrower sibling tags None — the
+        # unsigned view wins (all sums are the same mod-2^64 bits).
+        # Datetime never mixes with non-datetime (validated at execution).
+        if a == b:
+            return a
+        if {a, b} == {None, "uint64"}:
+            return "uint64"
+        raise ValueError("partial payloads disagree on query shape")
+
     value_kinds = first.get("value_kinds")
     for p in payloads[1:]:
         if (
             p["key_cols"] != key_cols
             or p["ops"] != ops
             or p["out_cols"] != out_cols
-            or p.get("value_kinds") != value_kinds
         ):
             raise ValueError("partial payloads disagree on query shape")
+        theirs = p.get("value_kinds")
+        if theirs != value_kinds:
+            if value_kinds is None or theirs is None:
+                raise ValueError(
+                    "partial payloads disagree on query shape"
+                )
+            value_kinds = [
+                _merge_kinds(a, b) for a, b in zip(value_kinds, theirs)
+            ]
     if len(payloads) == 1:
         return dict(first)
 
@@ -176,10 +197,8 @@ def _merge_partials(payloads):
 
     def scatter(rule, parts, dtype):
         if rule in (np.minimum, np.maximum):
-            fill = (
-                np.inf if rule is np.minimum else -np.inf
-            ) if np.issubdtype(dtype, np.floating) else (
-                np.iinfo(dtype).max if rule is np.minimum else np.iinfo(dtype).min
+            fill = extremum_fill(
+                dtype, "min" if rule is np.minimum else "max"
             )
             out = np.full(n_global, fill, dtype=dtype)
         else:
@@ -213,7 +232,11 @@ def _merge_partials(payloads):
                 (g, np.asarray(p["aggs"][ai][pname]))
                 for g, p in zip(group_of, payloads)
             ]
-            merged[pname] = scatter(rule, parts, parts[0][1].dtype)
+            # widen across payloads: shards may store the same column at
+            # different widths, and adopting parts[0]'s dtype would
+            # truncate a wider sibling's extrema into the fill range
+            dtype = np.result_type(*[arr.dtype for _g, arr in parts])
+            merged[pname] = scatter(rule, parts, dtype)
         aggs.append(merged)
 
     return {
@@ -305,6 +328,10 @@ def finalize_table(merged):
                 )
         elif op == "sum":
             values = agg["sum"]
+            if vkind == "uint64":
+                # every kernel accumulates mod 2^64; unsigned columns just
+                # re-view the same bits (pandas keeps uint64 sums unsigned)
+                values = np.asarray(values).astype(np.int64).view(np.uint64)
         elif op in ("count", "count_na"):
             values = agg["count"]
         elif op == "count_distinct":

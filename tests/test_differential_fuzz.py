@@ -58,6 +58,9 @@ def _dataset(seed):
                     ).astype(np.float32),
                     "v_bool": rng.random(n) < 0.3,
                     "v_u32": rng.integers(0, 2**32, n).astype(np.uint32),
+                    "v_u64": rng.integers(
+                        2**62, 2**64 - 1, n, dtype=np.uint64
+                    ),
                     "sel": rng.random(n).astype(np.float64),
                 }
             )
@@ -111,6 +114,14 @@ CASES = [
     # exact through the limb/native paths
     (["k_int"], [["v_bool", "sum", "s"], ["v_bool", "mean", "m"]], []),
     (["k_int"], [["v_u32", "sum", "s"], ["v_u32", "max", "hi"]], []),
+    # uint64 above 2^63: sums stay unsigned mod 2^64 (pandas), extrema
+    # keep the native unsigned ordering
+    (["k_int"], [["v_u64", "sum", "s"], ["v_u64", "min", "lo"]], []),
+    # integer MEANS accumulate float like pandas: group sums here exceed
+    # 2^63/2^64, where dividing a wrapped int sum would corrupt the mean
+    (["k_int"], [["v_big", "mean", "m"], ["v_u64", "mean", "mu"]], []),
+    # bool extrema (any/all semantics) and the empty-group fill path
+    (["k_int"], [["v_bool", "min", "lo"], ["v_bool", "max", "hi"]], []),
     # equality predicates, incl. on a dict column and a datetime bound
     (["k_int"], [["v_small", "sum", "s"]], [["k_str", "==", "b"]]),
     (["k_str"], [["v_small", "sum", "s"]], [["k_int", "!=", 3]]),

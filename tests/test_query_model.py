@@ -322,3 +322,54 @@ def test_null_dict_key_multikey_both_paths(tmp_path, monkeypatch):
         assert got["k"].tolist() == expected["k"].tolist(), f"cap={cap}"
         assert got["g"].tolist() == expected["g"].tolist(), f"cap={cap}"
         assert got["s"].tolist() == expected["s"].tolist(), f"cap={cap}"
+
+
+def test_mixed_width_unsigned_shards_merge(tmp_path):
+    """One shard stores a column as uint64, a sibling as uint32: the
+    engine tags them 'uint64' and None respectively, and the merge must
+    reconcile to the unsigned view instead of rejecting the payloads."""
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    a = pd.DataFrame(
+        {"g": [1, 2], "v": np.array([2**63, 7], dtype=np.uint64)}
+    )
+    b = pd.DataFrame(
+        {"g": [1, 2], "v": np.array([5, 9], dtype=np.uint32)}
+    )
+    pa, pb = str(tmp_path / "a.bcolzs"), str(tmp_path / "b.bcolzs")
+    CT.fromdataframe(a, pa)
+    CT.fromdataframe(b, pb)
+    query = GroupByQuery(
+        ["g"],
+        [["v", "sum", "s"], ["v", "min", "lo"], ["v", "max", "hi"]],
+        [],
+        aggregate=True,
+    )
+    engine = QueryEngine()
+    payloads = [
+        engine.execute_local(CT(p), query) for p in (pa, pb)
+    ]
+    for order in (payloads, payloads[::-1]):  # order independence
+        got = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads(list(order))
+        )
+        got = got.sort_values("g").reset_index(drop=True)
+        assert got["s"].tolist() == [2**63 + 5, 16]
+        assert str(got["s"].dtype) == "uint64"
+        # extrema must widen across payload dtypes, not truncate into
+        # the narrower first payload's range
+        assert got["lo"].tolist() == [5, 7]
+        assert got["hi"].tolist() == [2**63, 9]
+
+    # the same mixed-width shards on ONE worker (mesh executor) widen via
+    # result_type and must tag the unsigned view the same way
+    from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+
+    q2 = GroupByQuery(["g"], [["v", "sum", "s"]], [], aggregate=True)
+    payload = MeshQueryExecutor().execute([CT(pa), CT(pb)], q2)
+    got3 = hostmerge.payload_to_dataframe(
+        hostmerge.merge_payloads([payload])
+    )
+    got3 = got3.sort_values("g").reset_index(drop=True)
+    assert got3["s"].tolist() == [2**63 + 5, 16]
+    assert str(got3["s"].dtype) == "uint64"
