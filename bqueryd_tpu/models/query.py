@@ -360,11 +360,18 @@ def _value_kind_for(table, col):
     """Storage-kind tag carried per agg in the payload: 'datetime' restores
     datetime64 at finalize; 'uint64' re-views mod-2^64 sums as unsigned
     (every kernel path accumulates the same bits either way — only the
-    presentation differs, matching pandas' uint64 groupby sums)."""
+    presentation differs, matching pandas' uint64 groupby sums); 'uint'
+    marks narrower unsigned storage so a cross-shard merge can tell a
+    narrow unsigned sibling of a uint64 shard (reconcile to the unsigned
+    view) from a signed/float sibling (refuse: reinterpreting a widened
+    signed or float total as uint64 would corrupt it)."""
     if table.kind(col) == "datetime":
         return "datetime"
-    if table.physical_dtype(col) == np.dtype(np.uint64):
+    dt = table.physical_dtype(col)
+    if dt == np.dtype(np.uint64):
         return "uint64"
+    if dt.kind == "u":
+        return "uint"
     return None
 
 

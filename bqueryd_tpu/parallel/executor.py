@@ -131,8 +131,12 @@ def _measure_kind(tables, col):
     # the measures themselves widen via result_type (_stored_dtype), so the
     # unsigned tag must follow the WIDENED dtype: u64+u32 shards accumulate
     # in uint64 and their mod-2^64 sums still need the unsigned view
-    if dtypes and np.result_type(*dtypes) == np.dtype(np.uint64):
-        return "uint64"
+    if dtypes:
+        widened = np.result_type(*dtypes)
+        if widened == np.dtype(np.uint64):
+            return "uint64"
+        if widened.kind == "u":
+            return "uint"
     return None
 
 

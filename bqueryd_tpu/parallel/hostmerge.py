@@ -163,14 +163,21 @@ def _merge_partials(payloads):
     ops = first["ops"]
     out_cols = first["out_cols"]
     def _merge_kinds(a, b):
-        # shards may store the same column at different widths: a uint64
-        # shard tags 'uint64' while a narrower sibling tags None — the
-        # unsigned view wins (all sums are the same mod-2^64 bits).
-        # Datetime never mixes with non-datetime (validated at execution).
+        # Shards may store the same column at different widths.  A uint64
+        # shard merging with a NARROWER UNSIGNED sibling ('uint') keeps the
+        # unsigned view — all sums are the same mod-2^64 bits.  A signed or
+        # float sibling (None) makes the unsigned reinterpretation unsound
+        # (pandas widens those mixes to float/int64), so that mix is
+        # refused rather than silently corrupted.  'uint' next to a plain
+        # numeric sibling needs no special finalize at all.  Datetime never
+        # mixes with non-datetime (validated at execution).
         if a == b:
             return a
-        if {a, b} == {None, "uint64"}:
+        pair = {a, b}
+        if pair == {"uint64", "uint"}:
             return "uint64"
+        if pair == {"uint", None}:
+            return None
         raise ValueError("partial payloads disagree on query shape")
 
     value_kinds = first.get("value_kinds")
@@ -366,10 +373,23 @@ def finalize_table(merged):
 
 
 def payload_to_dataframe(merged):
-    """Final client-side conversion (pandas import isolated here)."""
+    """Final client-side conversion (pandas import isolated here).
+
+    String data and the column index are built at OBJECT dtype explicitly:
+    pandas 3 otherwise infers arrow-backed str arrays, whose construction
+    (``ArrowStringArray._from_sequence``) null-derefs inside libarrow 25.0
+    on some environments (observed: single-core hosts under this repo's
+    benchmark) — and the reference returned object-dtype strings anyway."""
     import pandas as pd
 
     order, columns = finalize_table(merged)
     if not order:
         return pd.DataFrame()
-    return pd.DataFrame({c: columns[c] for c in order}, columns=order)
+    data = {}
+    for c in order:
+        v = columns[c]
+        if getattr(v, "dtype", None) == object:
+            data[c] = pd.Series(v, dtype=object)
+        else:
+            data[c] = v
+    return pd.DataFrame(data, columns=pd.Index(order, dtype=object))

@@ -73,7 +73,8 @@ def assert_frames_match(got, expected, key_cols, **kw):
     expected = expected.sort_values(key_cols).reset_index(drop=True)
     expected = expected[list(got.columns)]
     pd.testing.assert_frame_equal(
-        got, expected, check_dtype=False, check_index_type=False, **kw
+        got, expected, check_dtype=False, check_index_type=False,
+        check_column_type=False, **kw
     )
 
 
@@ -292,6 +293,7 @@ def test_packed_fetch_matches_unpacked(tmp_path, monkeypatch):
     pd.testing.assert_frame_equal(
         df_p.sort_values("g").reset_index(drop=True),
         df_u.sort_values("g").reset_index(drop=True),
+        check_column_type=False,
     )
     expect = df.groupby("g")["big"].sum().sort_index()
     np.testing.assert_array_equal(

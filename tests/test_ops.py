@@ -541,6 +541,7 @@ def test_engine_routes_small_queries_to_host(monkeypatch, tmp_path):
     pd.testing.assert_frame_equal(
         df_h.sort_values("g").reset_index(drop=True),
         df_d.sort_values("g").reset_index(drop=True),
+        check_column_type=False,
     )
 
 

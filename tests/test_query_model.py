@@ -42,7 +42,7 @@ def run_query(table, *args, **kw):
 def assert_frames_match(got, expected, key_cols):
     got = got.sort_values(key_cols).reset_index(drop=True)
     expected = expected.sort_values(key_cols).reset_index(drop=True)
-    pd.testing.assert_frame_equal(got, expected, check_dtype=False,
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False,
                                   check_index_type=False)
 
 
@@ -173,7 +173,8 @@ def test_raw_rows_mode(table):
         df.trip_distance > 8.0, ["payment_type", "total_amount"]
     ].reset_index(drop=True)
     pd.testing.assert_frame_equal(
-        got.reset_index(drop=True), expected, check_dtype=False
+        got.reset_index(drop=True), expected, check_dtype=False,
+        check_column_type=False,
     )
 
 
@@ -373,3 +374,25 @@ def test_mixed_width_unsigned_shards_merge(tmp_path):
     got3 = got3.sort_values("g").reset_index(drop=True)
     assert got3["s"].tolist() == [2**63 + 5, 16]
     assert str(got3["s"].dtype) == "uint64"
+
+
+def test_uint64_mixed_with_float_shard_is_refused(tmp_path):
+    """A uint64 shard merging with a FLOAT sibling of the same column
+    cannot keep the unsigned reinterpretation (the widened float total is
+    not mod-2^64 bits); the merge must refuse loudly, not corrupt."""
+    import pytest as _pytest
+
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    a = pd.DataFrame(
+        {"g": [1], "v": np.array([2**63], dtype=np.uint64)}
+    )
+    b = pd.DataFrame({"g": [1], "v": np.array([0.5], dtype=np.float64)})
+    pa, pb = str(tmp_path / "a.bcolzs"), str(tmp_path / "b.bcolzs")
+    CT.fromdataframe(a, pa)
+    CT.fromdataframe(b, pb)
+    query = GroupByQuery(["g"], [["v", "sum", "s"]], [], aggregate=True)
+    engine = QueryEngine()
+    payloads = [engine.execute_local(CT(p), query) for p in (pa, pb)]
+    with _pytest.raises(ValueError, match="disagree"):
+        hostmerge.merge_payloads(payloads)

@@ -164,7 +164,7 @@ def test_groupby_single_file_vs_pandas(cluster, taxi_df):
             getattr(taxi_df.groupby("payment_type")["total_amount"], pandas_fn)()
             .reset_index()
         )
-        pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+        pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False)
 
 
 def test_groupby_sharded_matches_full(cluster):
@@ -180,7 +180,7 @@ def test_groupby_sharded_matches_full(cluster):
     )
     full = full.sort_values("payment_type").reset_index(drop=True)
     parts = parts.sort_values("payment_type").reset_index(drop=True)
-    pd.testing.assert_frame_equal(full, parts, check_dtype=False)
+    pd.testing.assert_frame_equal(full, parts, check_dtype=False, check_column_type=False)
 
 
 def test_groupby_with_filter(cluster, taxi_df):
@@ -195,7 +195,7 @@ def test_groupby_with_filter(cluster, taxi_df):
         .groupby("payment_type")["total_amount"].sum().reset_index()
     )
     got = got.sort_values("payment_type").reset_index(drop=True)
-    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False)
 
 
 def test_count_distinct_sharded(cluster, taxi_df):
@@ -214,7 +214,7 @@ def test_count_distinct_sharded(cluster, taxi_df):
         .reset_index(name="nuniq")
     )
     got = got.sort_values("payment_type").reset_index(drop=True)
-    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False)
 
 
 def test_count_distinct_single_file_device_path(cluster, taxi_df):
@@ -233,7 +233,7 @@ def test_count_distinct_single_file_device_path(cluster, taxi_df):
         .reset_index(name="nuniq")
     )
     got = got.sort_values("payment_type").reset_index(drop=True)
-    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False)
 
 
 def test_count_distinct_string_column_across_shards(tmp_path, mem_store_url):
@@ -278,7 +278,7 @@ def test_count_distinct_string_column_across_shards(tmp_path, mem_store_url):
         ).sort_values("g").reset_index(drop=True)
         full = pd.concat([s0, s1], ignore_index=True)
         exp = full.groupby("g")["pay"].nunique().reset_index(name="nuniq")
-        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False, check_column_type=False)
     finally:
         for n in (controller, worker):
             n.running = False
@@ -306,7 +306,7 @@ def test_raw_rows_mode_sharded(cluster, taxi_df):
     exp_s = expected[["payment_type", "total_amount"]].sort_values(
         ["payment_type", "total_amount"]
     ).reset_index(drop=True)
-    pd.testing.assert_frame_equal(got_s, exp_s, check_dtype=False)
+    pd.testing.assert_frame_equal(got_s, exp_s, check_dtype=False, check_column_type=False)
 
 
 def test_groupby_unknown_file_errors(cluster):
@@ -424,7 +424,7 @@ def test_batched_dispatch_merges_on_worker(cluster, taxi_df):
     g = taxi_df.groupby("payment_type")["total_amount"]
     expected = pd.DataFrame({"m": g.mean(), "s": g.sum()}).reset_index()
     got = got.sort_values("payment_type").reset_index(drop=True)
-    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False, check_column_type=False)
 
 
 def test_batch_false_restores_pershard_dispatch(cluster):
@@ -458,7 +458,8 @@ def test_legacy_merge_sum_of_shard_means(cluster, taxi_df):
     ).reset_index(name="m")
     got = got.sort_values("payment_type").reset_index(drop=True)
     pd.testing.assert_frame_equal(
-        got, expected.rename(columns={"total_amount": "m"}), check_dtype=False
+        got, expected.rename(columns={"total_amount": "m"}),
+        check_dtype=False, check_column_type=False,
     )
 
 
