@@ -61,6 +61,9 @@ def _dataset(seed):
                     "v_u64": rng.integers(
                         2**62, 2**64 - 1, n, dtype=np.uint64
                     ),
+                    "basket": np.sort(
+                        rng.integers(0, n // 8, n)
+                    ).astype(np.int64),
                     "sel": rng.random(n).astype(np.float64),
                 }
             )
@@ -359,3 +362,35 @@ def test_datetime_sum_mean_rejected(shards):
             QueryEngine().execute_local(tables[0], query)
         with pytest.raises(ValueError, match="not defined for datetime"):
             MeshQueryExecutor().execute(tables, query)
+
+
+
+@pytest.mark.parametrize(
+    "where",
+    [
+        [["sel", ">", 0.97]],
+        [["v_small", ">", 900]],
+    ],
+)
+def test_basket_expansion_matches_pandas(shards, where):
+    """expand_filter_column widens a row filter to whole baskets PER SHARD
+    (the reference's is_in_ordered_subgroups operates on each shard's
+    ordered basket column): any matching row selects its entire basket.
+    Ground truth: per-shard pandas transform-any, then a global groupby."""
+    frames, tables = shards
+    gcols, agg_list = ["k_int"], [["v_small", "sum", "s"]]
+    query = GroupByQuery(
+        gcols, agg_list, where, aggregate=True,
+        expand_filter_column="basket",
+    )
+    engine = QueryEngine()
+    payloads = [engine.execute_local(t, query) for t in tables]
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads(payloads))
+
+    expanded = []
+    for df in frames:
+        hit = _filter_df(df, where).index
+        keep = df["basket"].isin(df.loc[hit, "basket"].unique())
+        expanded.append(df[keep])
+    expected = _expected(expanded, gcols, agg_list, [])
+    _compare(got, expected, gcols, agg_list)
