@@ -683,3 +683,82 @@ def test_stop_is_a_shutdown_request_and_deregisters(
     assert not any(t.is_alive() for t in threads), "node loops did not exit"
     assert time.time() - t0 < 5, "external stop() took too long"
     assert store.smembers(bqueryd_tpu.REDIS_SET_KEY) == set()
+
+
+def test_groupby_through_either_controller(tmp_path, mem_store_url, monkeypatch):
+    """A worker registers with every controller in the store; the same
+    query asked through EACH controller must produce the same
+    pandas-checked answer (the reference's operational model: clients
+    may point at any controller, reference bqueryd/rpc.py:62-78)."""
+    import logging
+    import threading
+
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    monkeypatch.setenv("BQUERYD_TPU_WARMUP", "0")
+    rng = np.random.default_rng(21)
+    df = pd.DataFrame(
+        {
+            "g": rng.integers(0, 6, 4_000).astype(np.int64),
+            "v": rng.integers(-(2**40), 2**40, 4_000).astype(np.int64),
+        }
+    )
+    ctable.fromdataframe(df, str(tmp_path / "s0.bcolzs"))
+    expected = df.groupby("g")["v"].sum()
+
+    controllers = [
+        ControllerNode(
+            coordination_url=mem_store_url,
+            loglevel=logging.WARNING,
+            runfile_dir=str(tmp_path),
+            heartbeat_interval=0.1,
+        )
+        for _ in range(2)
+    ]
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    nodes = controllers + [worker]
+    threads = [
+        threading.Thread(target=n.go, daemon=True) for n in nodes
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for c in controllers:
+            wait_until(
+                lambda c=c: "s0.bcolzs" in c.files_map,
+                desc=f"shard registered at {c.address}",
+            )
+        results = []
+        for c in controllers:
+            rpc = RPC(
+                address=c.address,
+                coordination_url=mem_store_url,
+                loglevel=logging.WARNING,
+                timeout=30,
+            )
+            got = rpc.groupby(
+                ["s0.bcolzs"], ["g"], [["v", "sum", "s"]], []
+            )
+            got = got.sort_values("g").reset_index(drop=True)
+            assert got["g"].tolist() == expected.index.tolist()
+            assert got["s"].tolist() == expected.tolist()
+            results.append(got)
+        pd.testing.assert_frame_equal(results[0], results[1])
+    finally:
+        for n in nodes:
+            n.stop()
+        for t in threads:
+            t.join(timeout=5)
