@@ -23,7 +23,6 @@ port in 14300-14399, reference bqueryd/controller.py:33-42):
 """
 
 import binascii
-import json
 import os
 import pickle
 import random

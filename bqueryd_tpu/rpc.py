@@ -24,7 +24,6 @@ import time
 import zmq
 
 import bqueryd_tpu
-from bqueryd_tpu import messages
 from bqueryd_tpu.coordination import coordination_store
 from bqueryd_tpu.messages import ErrorMessage, RPCMessage, msg_factory
 

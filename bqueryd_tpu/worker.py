@@ -26,7 +26,6 @@ Control-plane behaviour keeps the reference's observable contract:
 import gc
 import importlib
 import os
-import random
 import signal
 import socket as socket_mod
 import sys
