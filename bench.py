@@ -33,7 +33,9 @@ Prints ONE compact JSON line LAST on stdout: {"metric", "value" (rows/s
 through the framework on the headline), "unit", "vs_baseline", "detail"}
 — kept under ~1.5 KB so log tails record it intact.  The full per-config
 breakdown (phase timings from the min-wall repeat, cold-path walls, the
-device round-trip floor) is written to BENCH_DETAIL.json next to this file.
+device round-trip floor) is written to BENCH_DETAIL.json next to this file
+(override with BENCH_DETAIL_PATH so probe/smoke runs don't clobber the
+committed round artifact).
 
 Timing discipline: each config runs one warmup query, then BENCH_REPEATS
 timed repeats; the reported wall is the min and the published phase timings
@@ -693,7 +695,10 @@ def main():
             if head_name
             else "taxi_groupby_none_completed"
         )
-        detail_path = os.path.join(
+        # overridable so probe-loop / smoke runs don't clobber the committed
+        # round artifact in place (two artifacts fighting over one path will
+        # eventually lose the good one)
+        detail_path = os.environ.get("BENCH_DETAIL_PATH") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
         )
         if completed:

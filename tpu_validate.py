@@ -1,0 +1,173 @@
+"""Hardware validation: run the differential-fuzz gates on the DEFAULT
+backend (the tunneled TPU) and bound the f64 sort+prefix-diff error.
+
+The test suite pins ``JAX_PLATFORMS=cpu`` (tests/conftest.py), so the float64
+sum path it exercises is the direct ``segment_sum`` — NOT the
+``_sorted_segment_sum`` prefix-diff path a TPU takes (``ops/groupby.py``
+routes f64 sums through the sort path on non-CPU backends, because TPUs have
+no native f64 and an emulated-f64 scatter dominates the query).  This script
+is the missing gate: it runs the same pandas-differential cases on whatever
+backend the machine provides (the axon TPU tunnel under normal env), plus a
+dedicated 1M-row f64 sum whose ground truth is ``math.fsum``, and records the
+max observed relative error per case.
+
+Usage:  python tpu_validate.py [out.json]
+Exit 0 iff every case passes the suite's own tolerances on this backend.
+"""
+
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+import traceback
+
+# device kernels only: the point is the TPU path, not the host fallback
+os.environ.setdefault("BQUERYD_TPU_HOST_KERNEL_ROWS", "0")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+
+import numpy as np
+import pandas as pd
+
+pd.set_option("future.infer_string", False)
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "TPU_VALIDATE.json"
+    t0 = time.time()
+    import jax
+
+    if os.environ.get("TPU_VALIDATE_FORCE_CPU") == "1":
+        # smoke-test mode: the machine's sitecustomize registers the axon
+        # tunnel backend unconditionally and a dead tunnel hangs the first
+        # device call even under JAX_PLATFORMS=cpu, so drop the factory
+        # in-process (same dance as tests/conftest.py)
+        import jax._src.xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        xb._backend_factories.pop("axon", None)
+
+    report = {
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "cases": {},
+        "f64_large": None,
+        "ok": False,
+    }
+
+    import test_differential_fuzz as fz
+    from bqueryd_tpu.models.query import GroupByQuery, QueryEngine
+    from bqueryd_tpu.parallel import hostmerge
+    from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+    from bqueryd_tpu.storage.ctable import ctable
+
+    frames = fz._dataset(seed=1234)
+    root = tempfile.mkdtemp(prefix="tpu_validate_")
+    tables = []
+    for i, df in enumerate(frames):
+        p = os.path.join(root, f"shard_{i}.bcolzs")
+        ctable.fromdataframe(df, p)
+        tables.append(ctable(p, mode="r"))
+
+    engine = QueryEngine()
+    failures = 0
+    for case_i, (gcols, agg_list, where) in enumerate(fz.CASES):
+        expected = fz._expected(frames, gcols, agg_list, where)
+        query = GroupByQuery(gcols, agg_list, where, aggregate=True)
+        for path in ("engine", "mesh"):
+            label = f"case{case_i}:{path}"
+            t = time.perf_counter()
+            try:
+                if path == "engine":
+                    payloads = [
+                        engine.execute_local(tbl, query) for tbl in tables
+                    ]
+                    got = hostmerge.payload_to_dataframe(
+                        hostmerge.merge_payloads(payloads)
+                    )
+                else:
+                    if not MeshQueryExecutor.supports(query):
+                        report["cases"][label] = {"status": "skipped"}
+                        continue
+                    payload = MeshQueryExecutor().execute(tables, query)
+                    got = hostmerge.payload_to_dataframe(
+                        hostmerge.merge_payloads([payload])
+                    )
+                fz._compare(got, expected, gcols, agg_list)
+                # max relative error across float outputs, for the record
+                max_rel = 0.0
+                g2 = got.sort_values(gcols).reset_index(drop=True)
+                e2 = expected.sort_values(gcols).reset_index(drop=True)
+                for in_col, op, out_col in agg_list:
+                    e = np.asarray(e2[out_col])
+                    if not np.issubdtype(e.dtype, np.floating):
+                        continue
+                    g = g2[out_col].to_numpy().astype(np.float64)
+                    denom = np.maximum(np.abs(e), 1e-30)
+                    with np.errstate(invalid="ignore"):
+                        rel = np.abs(g - e.astype(np.float64)) / denom
+                    rel = rel[np.isfinite(rel)]
+                    if rel.size:
+                        max_rel = max(max_rel, float(rel.max()))
+                report["cases"][label] = {
+                    "status": "pass",
+                    "wall_s": round(time.perf_counter() - t, 3),
+                    "max_rel_err": max_rel,
+                }
+            except Exception:
+                failures += 1
+                report["cases"][label] = {
+                    "status": "FAIL",
+                    "error": traceback.format_exc(limit=3),
+                }
+            print(
+                f"[tpu_validate] {label}: "
+                f"{report['cases'][label]['status']}",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    # dedicated f64 error bound at bench-like scale: 1M rows, 1000 groups,
+    # values spanning 12 orders of magnitude; truth = per-group math.fsum
+    try:
+        from bqueryd_tpu.ops import groupby as gb
+
+        rng = np.random.default_rng(7)
+        n, g = 1_000_000, 1_000
+        codes = rng.integers(0, g, n).astype(np.int64)
+        vals = (rng.random(n) * 2 - 1) * 10.0 ** rng.integers(-6, 6, n)
+        truth = np.array(
+            [
+                math.fsum(vals[codes == i].tolist())
+                for i in range(g)
+            ]
+        )
+        tbl = gb.partial_tables(codes, (vals,), ("sum",), g)
+        got = np.asarray(tbl["aggs"][0]["sum"])
+        denom = np.maximum(np.abs(truth), 1e-30)
+        rel = np.abs(got - truth) / denom
+        report["f64_large"] = {
+            "rows": n,
+            "groups": g,
+            "max_rel_err": float(rel.max()),
+            "max_abs_err": float(np.abs(got - truth).max()),
+            "pass": bool(np.allclose(got, truth, rtol=1e-9, atol=1e-6)),
+        }
+        if not report["f64_large"]["pass"]:
+            failures += 1
+    except Exception:
+        failures += 1
+        report["f64_large"] = {"error": traceback.format_exc(limit=3)}
+
+    report["ok"] = failures == 0
+    report["failures"] = failures
+    report["total_s"] = round(time.time() - t0, 1)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report[k] for k in ("backend", "ok", "failures")}))
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
