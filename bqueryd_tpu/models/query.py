@@ -298,9 +298,29 @@ def _host_ns_estimate(table, agg_list, n_rows):
             )
         return native_ok
 
+    minmax_ok = None
+
+    def _native_minmax_ok():
+        nonlocal minmax_ok
+        if minmax_ok is None:
+            from bqueryd_tpu.storage import native
+
+            minmax_ok = native.groupby_minmax_available()
+        return minmax_ok
+
     for in_col, op, _out in agg_list:
         if op in ("min", "max"):
-            if table.kind(in_col) == "datetime" or not native_takes_it():
+            # extrema need the dedicated min/max kernel, which also
+            # declines unsigned dtypes (uint64 would wrap its signed i64
+            # accumulator) — those queries run numpy ufunc.at, the slow rate
+            if (
+                table.kind(in_col) == "datetime"
+                or not native_takes_it()
+                or not _native_minmax_ok()
+                or np.issubdtype(
+                    table.physical_dtype(in_col), np.unsignedinteger
+                )
+            ):
                 return _HOST_NS_PER_ROW_SLOW  # numpy ufunc.at extrema
             continue
         if op in ("sum", "mean") and np.issubdtype(

@@ -759,7 +759,9 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
 
     rows = count_where(None if all_valid else valid)
     sentinels = _normalize_sentinels(null_sentinels, len(measures))
-    _minmax_cache = {}  # (values id, dtype) -> (mins, maxs, counts)
+    # (values id, dtype) -> (values, (mins, maxs, counts)); the array is
+    # cached alongside the result to pin its id() for the cache's lifetime
+    _minmax_cache = {}
     aggs = []
     for values, op, sentinel in zip(measures, ops, sentinels):
         if op not in MERGEABLE_OPS:
@@ -785,13 +787,17 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
             # kernel so cross-shard merges stay correct post-cast.  min and
             # max of the SAME measure share the pass via the cache.
             cache_key = (id(values), values.dtype.str)
-            hit = _minmax_cache.get(cache_key)
-            if hit is None:
+            entry = _minmax_cache.get(cache_key)
+            if entry is None:
                 hit = native_mod.groupby_minmax(
                     codes32, values, base_mask, minlength
                 )
-                _minmax_cache[cache_key] = hit
-            mns, mxs, cnts = hit
+                # the cached array keeps ``values`` alive so its id() can't
+                # be recycled onto a different same-dtype measure while the
+                # cache exists (callers may pass non-ndarray measures whose
+                # asarray conversion would otherwise die with the iteration)
+                _minmax_cache[cache_key] = entry = (values, hit)
+            mns, mxs, cnts = entry[1]
             ext64 = mns if op == "min" else mxs
             target = values.dtype
             ext = np.where(

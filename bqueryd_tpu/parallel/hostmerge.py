@@ -190,10 +190,15 @@ def _merge_partials(payloads):
             raise ValueError("partial payloads disagree on query shape")
         theirs = p.get("value_kinds")
         if theirs != value_kinds:
-            if value_kinds is None or theirs is None:
-                raise ValueError(
-                    "partial payloads disagree on query shape"
-                )
+            # a payload with no value_kinds at all (a worker running a
+            # pre-kinds build during a rolling restart) means "no special
+            # finalize anywhere" — merge as all-None and let _merge_kinds
+            # decide per column, raising only on genuinely incompatible
+            # kinds (uint64/datetime next to a plain numeric)
+            if value_kinds is None:
+                value_kinds = [None] * len(out_cols)
+            if theirs is None:
+                theirs = [None] * len(out_cols)
             value_kinds = [
                 _merge_kinds(a, b) for a, b in zip(value_kinds, theirs)
             ]

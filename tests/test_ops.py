@@ -656,6 +656,7 @@ def test_host_ns_estimate_routes_slow_measures(tmp_path):
             "small": np.array([1, -5, 9], dtype=np.int64),
             "huge": np.array([2**40, -(2**40), 7], dtype=np.int64),
             "f": np.array([0.5, 1.5, np.nan]),
+            "u": np.array([1, 2, 3], dtype=np.uint64),
         }
     )
     root = str(tmp_path / "est.bcolz")
@@ -679,6 +680,12 @@ def test_host_ns_estimate_routes_slow_measures(tmp_path):
     # so the same huge-bound query rates fast (when the lib is built)
     if _native.groupby_available():
         assert est(ct, [["huge", "sum", "s"]], 1_048_576) == fast
+    # extrema rate fast only when the DEDICATED min/max kernel will take
+    # them; unsigned dtypes decline it (signed i64 accumulator) and must
+    # keep the slow ufunc.at rate even above the native row floor
+    if _native.groupby_available() and _native.groupby_minmax_available():
+        assert est(ct, [["small", "min", "s"]], 1_048_576) == fast
+        assert est(ct, [["u", "min", "s"]], 1_048_576) == slow
     # the slow estimate shrinks the derived threshold proportionally
     # (conftest pins BQUERYD_TPU_HOST_KERNEL_ROWS=0 for determinism, so
     # lift it here to exercise the derived-threshold path)

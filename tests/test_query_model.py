@@ -396,3 +396,44 @@ def test_uint64_mixed_with_float_shard_is_refused(tmp_path):
     payloads = [engine.execute_local(CT(p), query) for p in (pa, pb)]
     with _pytest.raises(ValueError, match="disagree"):
         hostmerge.merge_payloads(payloads)
+
+
+def test_merge_tolerates_payload_without_value_kinds(tmp_path):
+    """A payload missing ``value_kinds`` entirely (a worker still running a
+    pre-kinds build during a rolling restart) must merge with a new-build
+    payload for plain numeric measures — only genuinely incompatible kinds
+    (uint64/datetime finalize next to kindless data) may refuse."""
+    import pytest as _pytest
+
+    from bqueryd_tpu.storage.ctable import ctable as CT
+
+    a = pd.DataFrame({"g": [1, 2], "v": np.array([3, 4], dtype=np.int64)})
+    b = pd.DataFrame({"g": [2, 3], "v": np.array([5, 6], dtype=np.int64)})
+    pa, pb = str(tmp_path / "a.bcolzs"), str(tmp_path / "b.bcolzs")
+    CT.fromdataframe(a, pa)
+    CT.fromdataframe(b, pb)
+    query = GroupByQuery(["g"], [["v", "sum", "s"]], [], aggregate=True)
+    engine = QueryEngine()
+    payloads = [engine.execute_local(CT(p), query) for p in (pa, pb)]
+    assert "value_kinds" in payloads[0]
+    del payloads[0]["value_kinds"]  # simulate the old-build worker
+    for order in (payloads, payloads[::-1]):
+        got = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads(list(order))
+        ).sort_values("g").reset_index(drop=True)
+        assert got["g"].tolist() == [1, 2, 3]
+        assert got["s"].tolist() == [3, 9, 6]
+
+    # but a uint64-kind payload next to a kindless one is ambiguous (the
+    # kindless sum may be a wrapped int64): still refused
+    u = pd.DataFrame(
+        {"g": [1], "v": np.array([2**63 + 1], dtype=np.uint64)}
+    )
+    pu = str(tmp_path / "u.bcolzs")
+    CT.fromdataframe(u, pu)
+    p_old = engine.execute_local(CT(pa), query)
+    del p_old["value_kinds"]
+    p_new = engine.execute_local(CT(pu), query)
+    assert "uint64" in p_new["value_kinds"]
+    with _pytest.raises(ValueError, match="disagree"):
+        hostmerge.merge_payloads([p_old, p_new])
