@@ -21,8 +21,9 @@ On-disk layout read here (bcolz 1.x):
 
 Chunks are Blosc v1 containers (16-byte header, block starts table, split
 streams) decoded by the native library (``native/tpucolz.cpp``,
-``tpc_blosc_decode``: blosclz + LZ4 + zlib codecs, byte-shuffle) with a pure
-Python fallback implementing the same public format.  Because split policy
+``tpc_blosc_decode``: blosclz + LZ4 + zlib codecs, byte-shuffle and
+bit-shuffle filters) with a pure Python fallback implementing the same
+public format.  Because split policy
 varied across c-blosc releases, both decoders validate split framing and
 retry the alternative split count rather than trusting the inference.
 
@@ -37,7 +38,11 @@ import zlib
 
 import numpy as np
 
-from bqueryd_tpu.storage.codec import _lz4_decompress_py, _unshuffle
+from bqueryd_tpu.storage.codec import (
+    _bitunshuffle,
+    _lz4_decompress_py,
+    _unshuffle,
+)
 
 #: exceptions that mean "this split framing / codec stream is inconsistent"
 #: — the retry-the-alternative-split signal (a wrong split guess feeds the
@@ -160,8 +165,6 @@ def _blosc_decode_chunk_py(buf):
     blocksize = int.from_bytes(buf[8:12], "little", signed=True)
     if nbytes < 0 or blocksize <= 0:
         raise ValueError("bad blosc header")
-    if flags & _BITSHUFFLE:
-        raise ValueError("bit-shuffled blosc chunks are not supported")
     if flags & _MEMCPYED:
         if len(buf) < 16 + nbytes:
             raise ValueError("truncated memcpyed chunk")
@@ -199,8 +202,13 @@ def _blosc_decode_chunk_py(buf):
                 err = exc
         if block is None:
             raise ValueError(f"block {b} undecodable: {err}")
+        # filter precedence mirrors c-blosc's blosc_d: byte-shuffle wins,
+        # else bit-shuffle (both per block; bit-shuffle applies at any
+        # typesize — bit-planes are its point for boolean data)
         if flags & _SHUFFLE and typesize > 1:
             block = _unshuffle(block, typesize)
+        elif flags & _BITSHUFFLE:
+            block = _bitunshuffle(block, typesize)
         out += block
     return bytes(out)
 

@@ -39,6 +39,47 @@ def _unshuffle(payload: bytes, elem_size: int) -> bytes:
     return out + payload[nelems * elem_size:]
 
 
+def _bitshuffle(payload: bytes, elem_size: int) -> bytes:
+    """c-blosc BITSHUFFLE filter (the bitshuffle library's
+    ``bshuf_trans_bit_elem`` layout): elements are truncated to a multiple
+    of 8, the truncated region is stored as ``elem_size * 8`` bit-planes —
+    plane ``(jj, kk)`` holds bit ``kk`` (LSB first) of byte ``jj`` of every
+    element, element index packed LSB-first 8 per byte — and trailing bytes
+    are copied through unshuffled (c-blosc shuffle.c ``bitshuffle()``).
+    Layout pinned against a direct port of the scalar reference pipeline in
+    tests/test_bcolz_v1.py."""
+    if elem_size <= 0:
+        return payload
+    nelems = (len(payload) // elem_size) & ~7
+    cut = nelems * elem_size
+    if nelems == 0:
+        return payload
+    arr = np.frombuffer(payload, dtype=np.uint8, count=cut)
+    bits = np.unpackbits(
+        arr.reshape(nelems, elem_size), axis=1, bitorder="little"
+    ).reshape(nelems, elem_size, 8)
+    planes = bits.transpose(1, 2, 0)  # (byte-of-elem, bit, element)
+    out = np.packbits(planes.reshape(-1), bitorder="little").tobytes()
+    return out + payload[cut:]
+
+
+def _bitunshuffle(payload: bytes, elem_size: int) -> bytes:
+    """Inverse of :func:`_bitshuffle` (same truncation + tail-copy rule)."""
+    if elem_size <= 0:
+        return payload
+    nelems = (len(payload) // elem_size) & ~7
+    cut = nelems * elem_size
+    if nelems == 0:
+        return payload
+    planes = np.unpackbits(
+        np.frombuffer(payload, dtype=np.uint8, count=cut),
+        bitorder="little",
+    ).reshape(elem_size, 8, nelems)
+    bits = planes.transpose(2, 0, 1)  # (element, byte-of-elem, bit)
+    out = np.packbits(bits.reshape(-1), bitorder="little").tobytes()
+    return out + payload[cut:]
+
+
 def _lz4_decompress_py(src: bytes, usize: int) -> bytes:
     """Pure-Python LZ4 block decoder (read-compat fallback)."""
     dst = bytearray()

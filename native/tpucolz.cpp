@@ -68,6 +68,59 @@ void unshuffle_bytes(const uint8_t* src, size_t n, size_t elem, uint8_t* dst) {
 }
 
 // ---------------------------------------------------------------------------
+// bit shuffle (c-blosc BITSHUFFLE filter inverse)
+// ---------------------------------------------------------------------------
+// The shuffled image stores, for each byte position jj of the element and
+// each bit kk (LSB first), a plane of nelems/8 bytes; plane byte m, bit i
+// is bit kk of byte jj of element 8m+i.  Elements are truncated to a
+// multiple of 8 and trailing bytes copied through unshuffled, mirroring
+// c-blosc shuffle.c bitshuffle()/bitunshuffle().  Layout pinned against a
+// direct port of the bitshuffle library's scalar reference pipeline in
+// tests/test_bcolz_v1.py.
+
+// 8x8 bit-matrix transpose (Hacker's Delight transpose8; the same routine
+// the bitshuffle library uses as TRANS_BIT_8X8): input byte kk bit i moves
+// to output byte i bit kk.
+inline uint64_t trans_bit_8x8(uint64_t x) {
+  uint64_t t;
+  t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AAULL;
+  x = x ^ t ^ (t << 7);
+  t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCCULL;
+  x = x ^ t ^ (t << 14);
+  t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0ULL;
+  x = x ^ t ^ (t << 28);
+  return x;
+}
+
+void bitunshuffle_bytes(const uint8_t* src, size_t n, size_t elem,
+                        uint8_t* dst) {
+  if (elem == 0) {
+    std::memcpy(dst, src, n);
+    return;
+  }
+  size_t nelems = (n / elem) & ~static_cast<size_t>(7);
+  const size_t cut = nelems * elem;
+  if (nelems) {
+    const size_t nbr = nelems / 8;  // bytes per bit-plane
+    for (size_t jj = 0; jj < elem; ++jj) {
+      const uint8_t* planes = src + jj * 8 * nbr;
+      for (size_t m = 0; m < nbr; ++m) {
+        uint64_t x = 0;
+        for (size_t kk = 0; kk < 8; ++kk) {
+          x |= static_cast<uint64_t>(planes[kk * nbr + m]) << (8 * kk);
+        }
+        x = trans_bit_8x8(x);
+        for (size_t i = 0; i < 8; ++i) {
+          dst[(8 * m + i) * elem + jj] =
+              static_cast<uint8_t>((x >> (8 * i)) & 0xFF);
+        }
+      }
+    }
+  }
+  if (cut < n) std::memcpy(dst + cut, src + cut, n - cut);
+}
+
+// ---------------------------------------------------------------------------
 // LZ4 block format (https-spec compatible), greedy hash-table compressor
 // ---------------------------------------------------------------------------
 
@@ -398,8 +451,7 @@ bool blosc_decode_block(const uint8_t* bp, size_t remain, size_t bsize,
 }
 
 // Decode one Blosc v1 chunk into dst (dst_cap >= header nbytes).  Returns
-// decoded byte count, or 0 on malformed input / unsupported feature
-// (bit-shuffle, unknown codec).
+// decoded byte count, or 0 on malformed input / unsupported codec.
 size_t blosc_chunk_decode(const uint8_t* src, size_t csize, uint8_t* dst,
                           size_t dst_cap) {
   BloscHeader h;
@@ -407,7 +459,6 @@ size_t blosc_chunk_decode(const uint8_t* src, size_t csize, uint8_t* dst,
   const size_t nbytes = static_cast<size_t>(h.nbytes);
   if (nbytes == 0) return 0;
   if (dst_cap < nbytes) return 0;
-  if (h.flags & kBloscBitShuffle) return 0;  // not produced by legacy bcolz
   if (h.flags & kBloscMemcpyed) {
     if (csize < 16 + nbytes) return 0;
     std::memcpy(dst, src + 16, nbytes);
@@ -429,8 +480,13 @@ size_t blosc_chunk_decode(const uint8_t* src, size_t csize, uint8_t* dst,
     if (start < 0 || static_cast<size_t>(start) > csize) return 0;
     const uint8_t* bp = src + start;
     size_t remain = csize - static_cast<size_t>(start);
+    // filter precedence mirrors c-blosc's blosc_d: byte-shuffle wins, else
+    // bit-shuffle (which applies at any typesize — bit-planes are its point
+    // for boolean data)
     const bool shuffled = (h.flags & kBloscShuffle) && typesize > 1;
-    uint8_t* block_dst = shuffled ? tmp.data() : dst + b * blocksize;
+    const bool bitshuffled = !shuffled && (h.flags & kBloscBitShuffle);
+    uint8_t* block_dst =
+        (shuffled || bitshuffled) ? tmp.data() : dst + b * blocksize;
 
     size_t primary =
         blosc_split_eligible(codec, typesize, bsize, leftover) ? typesize : 1;
@@ -439,7 +495,11 @@ size_t blosc_chunk_decode(const uint8_t* src, size_t csize, uint8_t* dst,
         (fallback == primary || fallback == 0 ||
          !blosc_decode_block(bp, remain, bsize, fallback, codec, block_dst)))
       return 0;
-    if (shuffled) unshuffle_bytes(tmp.data(), bsize, typesize, dst + b * blocksize);
+    if (shuffled) {
+      unshuffle_bytes(tmp.data(), bsize, typesize, dst + b * blocksize);
+    } else if (bitshuffled) {
+      bitunshuffle_bytes(tmp.data(), bsize, typesize, dst + b * blocksize);
+    }
   }
   return nbytes;
 }

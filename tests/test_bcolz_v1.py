@@ -82,7 +82,54 @@ def _shuffle(data, typesize):
     return head.tobytes() + bytes(arr[nelems * typesize:])
 
 
-def build_blosc_chunk(data, typesize, mode="blosclz", blocksize=None):
+def _trans_bit_8x8(x):
+    """Hacker's Delight transpose8 — the TRANS_BIT_8X8 macro of the
+    bitshuffle library (public algorithm; this port exists so the fixture
+    encoder is INDEPENDENT of the numpy production code it validates)."""
+    m = 0xFFFFFFFFFFFFFFFF
+    t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AA
+    x = x ^ t ^ ((t << 7) & m)
+    t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCC
+    x = x ^ t ^ ((t << 14) & m)
+    t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0
+    x = x ^ t ^ ((t << 28) & m)
+    return x & m
+
+
+def scalar_bitshuffle_reference(data, typesize):
+    """Direct port of the bitshuffle library's scalar pipeline
+    (``bshuf_trans_byte_elem`` -> ``bshuf_trans_bit_byte`` ->
+    ``bshuf_trans_bitrow_eight``) wrapped with c-blosc shuffle.c's
+    ``bitshuffle()`` truncation rule: elements truncated to a multiple of
+    8, trailing bytes copied through."""
+    nelems = (len(data) // typesize) & ~7
+    cut = nelems * typesize
+    if nelems == 0:
+        return bytes(data)
+    src = np.frombuffer(data[:cut], np.uint8)
+    # stage 1: transpose bytes within elements
+    s1 = src.reshape(nelems, typesize).T.copy().reshape(-1)
+    # stage 2: transpose bits within bytes (8 bytes -> 8 bit-planes)
+    nbyte = cut
+    nbr = nbyte // 8
+    s2 = np.zeros(nbyte, np.uint8)
+    for ii in range(0, nbyte, 8):
+        x = _trans_bit_8x8(int.from_bytes(s1[ii:ii + 8].tobytes(), "little"))
+        for kk in range(8):
+            s2[kk * nbr + ii // 8] = (x >> (8 * kk)) & 0xFF
+    # stage 3: regroup bit-rows per byte-of-element
+    row = nelems // 8
+    s3 = np.zeros(nbyte, np.uint8)
+    for jj in range(typesize):
+        for kk in range(8):
+            dst_off = (jj * 8 + kk) * row
+            src_off = (kk * typesize + jj) * row
+            s3[dst_off:dst_off + row] = s2[src_off:src_off + row]
+    return s3.tobytes() + data[cut:]
+
+
+def build_blosc_chunk(data, typesize, mode="blosclz", blocksize=None,
+                      bitshuffle=False):
     """One Blosc v1 chunk: 16-byte header + bstarts + split streams."""
     nbytes = len(data)
     if mode == "memcpy":
@@ -93,13 +140,18 @@ def build_blosc_chunk(data, typesize, mode="blosclz", blocksize=None):
     blocksize = blocksize or max(typesize, min(nbytes, 4096))
     if blocksize % typesize:
         blocksize += typesize - blocksize % typesize
-    flags = 0x1 if typesize > 1 else 0  # byte-shuffle
+    if bitshuffle:
+        flags = 0x4  # bit-shuffle (applies at any typesize)
+    else:
+        flags = 0x1 if typesize > 1 else 0  # byte-shuffle
     nblocks = -(-nbytes // blocksize)
     streams = []
     for b in range(nblocks):
         raw = data[b * blocksize:(b + 1) * blocksize]
         leftover = len(raw) != blocksize
-        if typesize > 1:
+        if bitshuffle:
+            raw = scalar_bitshuffle_reference(raw, typesize)
+        elif typesize > 1:
             raw = _shuffle(raw, typesize)
         splittable = (
             not leftover
@@ -136,7 +188,7 @@ def build_blosc_chunk(data, typesize, mode="blosclz", blocksize=None):
 # ---------------------------------------------------------------------------
 
 def write_bcolz_v1_carray(rootdir, values, chunklen=1000, mode="blosclz",
-                          raw_leftover=False):
+                          raw_leftover=False, bitshuffle=False):
     values = np.ascontiguousarray(values)
     os.makedirs(os.path.join(rootdir, "meta"))
     os.makedirs(os.path.join(rootdir, "data"))
@@ -149,7 +201,12 @@ def write_bcolz_v1_carray(rootdir, values, chunklen=1000, mode="blosclz",
         json.dump(
             {
                 "dtype": str(values.dtype.str),
-                "cparams": {"clevel": 5, "shuffle": 1, "cname": "blosclz"},
+                "cparams": {
+                    "clevel": 5,
+                    # bcolz constants: 1 = SHUFFLE, 2 = BITSHUFFLE
+                    "shuffle": 2 if bitshuffle else 1,
+                    "cname": "blosclz",
+                },
                 "chunklen": chunklen,
                 "dflt": 0,
                 "expectedlen": len(values),
@@ -160,7 +217,11 @@ def write_bcolz_v1_carray(rootdir, values, chunklen=1000, mode="blosclz",
     for i in range(nfull):
         chunk = values[i * chunklen:(i + 1) * chunklen].tobytes()
         with open(os.path.join(rootdir, "data", f"__{i}.blp"), "wb") as f:
-            f.write(build_blosc_chunk(chunk, typesize, mode=mode))
+            f.write(
+                build_blosc_chunk(
+                    chunk, typesize, mode=mode, bitshuffle=bitshuffle
+                )
+            )
     left = values[nfull * chunklen:]
     if len(left):
         path = os.path.join(rootdir, "data", "__leftover.blp")
@@ -168,7 +229,12 @@ def write_bcolz_v1_carray(rootdir, values, chunklen=1000, mode="blosclz",
             if raw_leftover:
                 f.write(left.tobytes())
             else:
-                f.write(build_blosc_chunk(left.tobytes(), typesize, mode=mode))
+                f.write(
+                    build_blosc_chunk(
+                        left.tobytes(), typesize, mode=mode,
+                        bitshuffle=bitshuffle,
+                    )
+                )
 
 
 def write_bcolz_v1_ctable(rootdir, frame, chunklen=1000, mode="blosclz"):
@@ -257,6 +323,60 @@ def test_python_and_native_chunk_decoders_agree():
         nbytes, typesize, flags = native.blosc_info(chunk)
         assert (nbytes, typesize) == (values.nbytes, 8)
         assert native.blosc_decode(chunk, nbytes) == values.tobytes()
+
+
+def test_bitshuffle_codec_matches_scalar_reference():
+    """The production numpy bit-(un)shuffle must match the independent
+    direct port of the bitshuffle library's scalar pipeline for every
+    typesize class, including the non-multiple-of-8-elements tail that
+    c-blosc copies through unshuffled."""
+    from bqueryd_tpu.storage.codec import _bitshuffle, _bitunshuffle
+
+    rng = np.random.default_rng(11)
+    for typesize in (1, 2, 3, 4, 8, 16):
+        for nelems in (8, 64, 133):  # 133: 5-element unshuffled tail
+            data = rng.integers(
+                0, 256, nelems * typesize, dtype=np.uint8
+            ).tobytes() + b"\x7f" * (typesize // 2)  # ragged byte tail
+            ref = scalar_bitshuffle_reference(data, typesize)
+            assert _bitshuffle(data, typesize) == ref, (
+                f"forward layout diverges at typesize={typesize}"
+            )
+            assert _bitunshuffle(ref, typesize) == data, (
+                f"inverse does not recover at typesize={typesize}"
+            )
+
+
+def test_bitshuffled_chunk_decoders_agree():
+    """A bit-shuffled chunk (flag 0x4) decodes identically through the
+    Python and native paths — including the split-stream framing, which
+    c-blosc applies independently of the shuffle filter."""
+    rng = np.random.default_rng(13)
+    for typesize, values in (
+        (8, rng.integers(0, 50, 4096).astype(np.int64)),
+        (1, (rng.random(4096) < 0.2)),  # bools: bitshuffle's home turf
+        (4, rng.normal(size=2048).astype(np.float32)),
+    ):
+        chunk = build_blosc_chunk(
+            values.tobytes(), typesize, bitshuffle=True
+        )
+        assert bcolz_v1._blosc_decode_chunk_py(chunk) == values.tobytes()
+        if native.blosc_available():
+            nbytes, _ts, flags = native.blosc_info(chunk)
+            assert flags & 0x4
+            assert native.blosc_decode(chunk, nbytes) == values.tobytes()
+
+
+def test_read_carray_bitshuffle_roundtrip(tmp_path):
+    """A bcolz v1 carray written with shuffle=bcolz.BITSHUFFLE reads back
+    exactly, leftover chunk (non-multiple-of-8 elements) included."""
+    rng = np.random.default_rng(17)
+    values = rng.integers(-(2**30), 2**30, 2513).astype(np.int64)
+    write_bcolz_v1_carray(
+        str(tmp_path / "c"), values, chunklen=1000, bitshuffle=True
+    )
+    got = bcolz_v1.read_carray(str(tmp_path / "c"))
+    np.testing.assert_array_equal(got, values)
 
 
 def test_memcpyed_chunk():
