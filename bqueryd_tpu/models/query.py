@@ -445,6 +445,26 @@ class QueryEngine:
         hit = self._factorize_cache.get(cache_key)
         if hit is not None:
             return hit
+        # disk sidecar (bquery's auto_cache analogue, stored POST null-poison
+        # so a load skips the NaN/NaT scan too) before paying the
+        # decode+factorize
+        loader = getattr(table, "factor_cache_load", None)
+        if loader is not None:
+            disk = loader(col)
+            if disk is not None:
+                codes, uniques = disk
+                if kind == "datetime" and uniques.dtype.kind != "M":
+                    uniques = uniques.view("datetime64[ns]")
+                self._factorize_cache.put(
+                    cache_key, (codes, uniques),
+                    nbytes=codes.nbytes + uniques.nbytes,
+                )
+                return codes, uniques
+        # stamp BEFORE the read: if the shard is rewritten mid-factorize the
+        # sidecar lands stale (future miss), never poisoned (see the TOCTOU
+        # note in storage/ctable.py)
+        stamper = getattr(table, "factor_stamp", None)
+        stamp = stamper(col) if stamper is not None else None
         raw = table.column_raw(col)
         codes, uniques = ops.factorize(raw)
         if kind == "datetime":
@@ -462,6 +482,9 @@ class QueryEngine:
             codes = np.where(
                 np.isin(codes, null_at), np.int64(-1), codes
             )
+        storer = getattr(table, "factor_cache_store", None)
+        if storer is not None and stamp is not None:
+            storer(col, codes, uniques, stamp=stamp)
         self._factorize_cache.put(
             cache_key, (codes, uniques), nbytes=codes.nbytes + uniques.nbytes
         )

@@ -199,6 +199,25 @@ class MeshQueryExecutor:
         if self._align_engine is not None:
             self._align_engine.clear_caches()
 
+    @staticmethod
+    def _map_shards(fn, items):
+        """Map ``fn`` over shards on a short-lived thread pool (the
+        decode/factorize/np work dominating cold alignment releases the
+        GIL); sequential for single shards or under BQUERYD_TPU_ALIGN_THREADS=1."""
+        items = list(items)
+        workers = int(
+            os.environ.get(
+                "BQUERYD_TPU_ALIGN_THREADS",
+                min(len(items), os.cpu_count() or 4, 16),
+            )
+        )
+        if len(items) <= 1 or workers <= 1:
+            return [fn(it) for it in items]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(fn, items))
+
     def _engine(self):
         """The engine used for alignment/key factorization — persistent so
         its factorize cache survives across queries (a fresh engine per
@@ -249,52 +268,147 @@ class MeshQueryExecutor:
         n_cols = len(query.groupby_cols)
         shard_codes = [[] for _ in range(n_cols)]   # [col][shard] -> codes
         shard_values = [[] for _ in range(n_cols)]  # [col][shard] -> uniques
-        for table in tables:
-            for ci, col in enumerate(query.groupby_cols):
-                codes, values = engine._key_codes(table, col)
+        # composite-sidecar stamps, captured BEFORE any key column is read
+        # (TOCTOU note in storage/ctable.py): a mid-align shard rewrite then
+        # stores a stale-stamped sidecar that future loads miss
+        comp_stamps = [
+            getattr(t, "composite_stamp", lambda cols: None)(
+                query.groupby_cols
+            )
+            for t in tables
+        ]
+        # per-shard decode+factorize is embarrassingly parallel and the
+        # native decode/factorize/np IO all release the GIL; the caches the
+        # engine touches are lock-protected (utils/cache.BytesCappedCache)
+        per_table = self._map_shards(
+            lambda table: [
+                engine._key_codes(table, col)
+                for col in query.groupby_cols
+            ],
+            tables,
+        )
+        for results in per_table:
+            for ci, (codes, values) in enumerate(results):
                 shard_codes[ci].append(np.asarray(codes))
                 shard_values[ci].append(np.asarray(values))
 
         cards = []
         global_values = []
-        global_codes = [[] for _ in range(len(tables))]  # [shard][col]
+        pos_maps = [[] for _ in range(n_cols)]  # [col][shard] -> local->global
         for ci in range(n_cols):
             allv = np.concatenate(shard_values[ci])
             gvals = np.unique(allv)
+            # strip null VALUES (float NaN / datetime NaT) from the global
+            # dictionary: the rows referencing them already carry poisoned
+            # codes (-1, models/query._key_codes), so keeping the null entry
+            # would only create a never-referenced dictionary slot — and the
+            # single-key dense shortcut below needs "every dictionary entry
+            # is an observed group" to hold exactly
+            if gvals.dtype.kind == "f":
+                gvals = gvals[~np.isnan(gvals)]
+            elif gvals.dtype.kind == "M":
+                gvals = gvals[~np.isnat(gvals)]
             cards.append(max(len(gvals), 1))
             global_values.append(gvals)
             for si in range(len(tables)):
-                # local dictionary -> global position, gathered through the
-                # local codes; null codes (<0) stay null
-                pos = np.searchsorted(gvals, shard_values[ci][si])
-                codes = shard_codes[ci][si]
-                mapped = np.where(
-                    codes >= 0, pos[np.clip(codes, 0, None)], np.int64(-1)
+                # local dictionary -> global position (dictionary-sized);
+                # the rows-sized gather through it happens lazily so a
+                # composite-sidecar hit below skips it entirely
+                pos_maps[ci].append(
+                    np.searchsorted(gvals, shard_values[ci][si])
                 )
-                global_codes[si].append(mapped)
+
+        def mapped_codes(si, ci):
+            # gather local codes through the local->global map; null codes
+            # (<0) stay null
+            codes = shard_codes[ci][si]
+            pos = pos_maps[ci][si]
+            return np.where(
+                codes >= 0, pos[np.clip(codes, 0, None)], np.int64(-1)
+            )
 
         from bqueryd_tpu import ops
 
-        per_shard_packed = []
-        for si in range(len(tables)):
-            if n_cols == 1:
-                packed = global_codes[si][0].astype(np.int64)
-            else:
-                packed = ops.pack_codes(global_codes[si], cards)
-            per_shard_packed.append(packed)
+        if n_cols == 1:
+            # dense shortcut: every global dictionary entry came from some
+            # shard's factorize/dictionary, so it is observed in >=1 row —
+            # the global codes are ALREADY dense positions in the sorted
+            # dictionary.  Skips the former rows-scale unique, which was
+            # ~80% of the cold align wall at bench shapes.
+            combos = np.arange(len(global_values[0]), dtype=np.int64)
+            dense = self._map_shards(
+                lambda si: mapped_codes(si, 0).astype(np.int64),
+                range(len(tables)),
+            )
+            key_values = dict(zip(query.groupby_cols, global_values))
+            return dense, combos, cards, key_values
 
-        observed = [p[p >= 0] for p in per_shard_packed]
+        # multi-key: observed composites per shard via the native hash
+        # factorizer (O(rows) per shard, small unique sets) instead of one
+        # rows-scale sort-unique over the concatenated shards.  The result
+        # is persisted next to the shard (composite sidecar) keyed by a
+        # digest of the GLOBAL dictionaries + cardinalities: packed codes
+        # depend on the whole shard set, so any set change invalidates.
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.asarray(cards, dtype=np.int64).tobytes())
+        for g in global_values:
+            a = np.asarray(g)
+            if a.dtype == object:
+                h.update(repr(a.tolist()).encode())
+            else:
+                h.update(a.dtype.str.encode())
+                h.update(a.tobytes())
+        digest = h.digest()
+
+        def shard_composites(si):
+            table = tables[si]
+            loader = getattr(table, "composite_cache_load", None)
+            if loader is not None:
+                # validate against the PRE-READ stamp: shard_codes came from
+                # those bytes, not from whatever the file holds now
+                hit = loader(
+                    query.groupby_cols, digest, stamp=comp_stamps[si]
+                )
+                if hit is not None:
+                    return (
+                        np.asarray(hit[0]),
+                        np.asarray(hit[1], dtype=np.int64),
+                    )
+            packed = ops.pack_codes(
+                [mapped_codes(si, ci) for ci in range(n_cols)], cards
+            )
+            inv, uniq = ops.factorize(packed)
+            inv = np.asarray(inv)
+            uniq = np.asarray(uniq, dtype=np.int64)
+            storer = getattr(table, "composite_cache_store", None)
+            if storer is not None and comp_stamps[si] is not None:
+                storer(
+                    query.groupby_cols, digest, inv, uniq,
+                    stamp=comp_stamps[si],
+                )
+            return inv, uniq
+
+        composites = self._map_shards(shard_composites, range(len(tables)))
+        local_inverse = [c[0] for c in composites]
+        local_uniques = [c[1] for c in composites]
+        observed = [u[u >= 0] for u in local_uniques]
         observed = [o for o in observed if len(o)]
         combos = (
             np.unique(np.concatenate(observed))
             if observed
             else np.empty(0, dtype=np.int64)
         )
-        # dense codes: position of each packed composite in the sorted combos
+        # dense codes ride the per-shard dictionary: map each shard's few
+        # observed composites into the sorted global combos, then gather
         dense = []
-        for packed in per_shard_packed:
-            pos = np.searchsorted(combos, np.clip(packed, 0, None))
-            dense.append(np.where(packed >= 0, pos, np.int64(-1)))
+        for inv, uniq in zip(local_inverse, local_uniques):
+            lut = np.searchsorted(combos, np.clip(uniq, 0, None)).astype(
+                np.int64
+            )
+            lut[uniq < 0] = -1
+            dense.append(lut[inv])
         key_values = dict(zip(query.groupby_cols, global_values))
         return dense, combos, cards, key_values
 

@@ -123,6 +123,54 @@ def _cache_put(key, arr):
     _COLUMN_CACHE.put(key, arr)
 
 
+# -- sidecar persistence helpers (factor + composite caches) ---------------
+
+def _sidecar_enabled():
+    return os.environ.get("BQUERYD_TPU_DISK_FACTOR_CACHE", "1") == "1"
+
+
+def _sidecar_save(dirname, path, **arrays):
+    """Atomic best-effort npz write (tempfile + rename); failures are
+    swallowed — read-only media just keeps paying the recompute."""
+    import tempfile
+
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".sidecar.tmp")
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _sidecar_load(path, stamp, digest=None):
+    """(codes, uniques) from an npz sidecar iff its stamp (and digest, when
+    given) match; None on absent/stale/corrupt."""
+    if stamp is None:
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if not np.array_equal(z["stamp"], stamp):
+                return None
+            if digest is not None and z["digest"].tobytes() != digest:
+                return None
+            return z["codes"], z["uniques"]
+    except Exception:
+        return None
+
+
+def _narrow_codes(codes, uniques):
+    codes = np.asarray(codes)
+    if len(uniques) < 2**31 and codes.dtype != np.int32:
+        codes = codes.astype(np.int32)  # halves sidecar IO
+    return codes
+
+
 class ctable:
     """Open (mode='r'/'a') or create (mode='w') a tpucolz table directory."""
 
@@ -242,6 +290,109 @@ class ctable:
             cached = {v: i for i, v in enumerate(dictionary)}
             self._dict_lookups[name] = cached
         return cached
+
+    # -- on-disk factorize cache -------------------------------------------
+    # The full analogue of bquery's auto_cache=True (reference
+    # bqueryd/worker.py:291): factorizations persist NEXT TO THE SHARD, so a
+    # cold process (or a different worker adopting the shard) skips the
+    # decode+factorize entirely.  Validated against the column data file's
+    # (mtime, size) and the table's row count — reshard/activation rewrites
+    # the data file, invalidating naturally; a plain directory move keeps
+    # both, and keeps the cache valid, which is correct (content unchanged).
+    #
+    # TOCTOU discipline: callers must capture the stamp BEFORE reading the
+    # column bytes they factorize and pass it to the store.  If the shard is
+    # rewritten mid-computation, the sidecar then lands with the OLD stamp
+    # and every future load misses (recompute) — stamping at store time
+    # would instead pair new-stamp with old-bytes codes and poison the
+    # cache permanently.
+
+    _FACTOR_CACHE_VERSION = 1
+
+    def factor_stamp(self, name):
+        """Identity of one column's data bytes (+ table rows); capture
+        before reading, pass to the matching ``*_cache_store``.  st_ino
+        closes the same-mtime same-size atomic-rewrite window exactly as
+        :func:`rootdir_cache_key` does for meta.json; a same-filesystem
+        directory move is a rename (inode kept, cache stays valid — content
+        unchanged), a cross-filesystem copy invalidates conservatively."""
+        try:
+            st = os.stat(self._col_path(name, "data.tpc"))
+        except OSError:
+            return None
+        return np.array(
+            [self._FACTOR_CACHE_VERSION, st.st_mtime_ns, st.st_size,
+             st.st_ino, self.nrows],
+            dtype=np.int64,
+        )
+
+    def composite_stamp(self, cols):
+        stamps = [self.factor_stamp(c) for c in cols]
+        if any(s is None for s in stamps):
+            return None
+        return np.concatenate(stamps)
+
+    def _composite_path(self, cols):
+        tag = zlib.crc32("|".join(_enc_name(c) for c in cols).encode())
+        return self._col_path(cols[0], f"composite_{tag:08x}.npz")
+
+    def factor_cache_load(self, name):
+        """Load a persisted (codes, uniques) factorization for a column, or
+        None when absent/stale/disabled."""
+        if not _sidecar_enabled():
+            return None
+        return _sidecar_load(
+            self._col_path(name, "factor.npz"), self.factor_stamp(name)
+        )
+
+    def factor_cache_store(self, name, codes, uniques, stamp):
+        """Persist a factorization sidecar (atomic, best-effort: read-only
+        media simply keeps paying the factorize).  ``stamp`` must have been
+        captured via :meth:`factor_stamp` before the column was read."""
+        uniques = np.asarray(uniques)
+        if not _sidecar_enabled() or stamp is None:
+            return
+        if uniques.dtype == object:
+            return  # npz would need pickle; object keys never take this path
+        _sidecar_save(
+            self._col_dir(name),
+            self._col_path(name, "factor.npz"),
+            stamp=stamp,
+            codes=_narrow_codes(codes, uniques),
+            uniques=uniques,
+        )
+
+    def composite_cache_load(self, cols, digest, stamp=None):
+        """Load a persisted multi-key composite factorization
+        (packed-code inverse + observed composites), or None.  ``digest``
+        must capture everything the packed codes depend on beyond this
+        shard's data — the executor hashes the global dictionaries and
+        cardinalities into it, so a change in the SHARD SET invalidates.
+        Pass the ``stamp`` captured before the key columns were read so the
+        sidecar is validated against the bytes the caller actually holds,
+        not whatever the file mutated into since."""
+        if not _sidecar_enabled():
+            return None
+        if stamp is None:
+            stamp = self.composite_stamp(cols)
+        return _sidecar_load(
+            self._composite_path(cols), stamp, digest=digest
+        )
+
+    def composite_cache_store(self, cols, digest, codes, uniques, stamp):
+        """``stamp`` must come from :meth:`composite_stamp` captured before
+        the key columns were read (see the TOCTOU note above)."""
+        if not _sidecar_enabled() or stamp is None:
+            return
+        uniques = np.asarray(uniques)
+        _sidecar_save(
+            self._col_dir(cols[0]),
+            self._composite_path(cols),
+            stamp=stamp,
+            digest=np.frombuffer(digest, dtype=np.uint8),
+            codes=_narrow_codes(codes, uniques),
+            uniques=uniques,
+        )
 
     def column_raw(self, name):
         """Physical column values as one contiguous ndarray: int32 codes for

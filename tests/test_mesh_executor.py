@@ -6,6 +6,8 @@ QueryEngine + host-merge path, for single/multi key, filters, string keys,
 and shard counts above/below the device count.
 """
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -356,3 +358,49 @@ def test_packed_fetch_spec_stable_across_kernel_routes(tmp_path, monkeypatch):
     np.testing.assert_allclose(
         result_means(small), expect_means(df_small), rtol=1e-6
     )
+
+
+def test_cold_path_hits_disk_sidecars_and_matches(sharded, mesh):
+    """Warm query -> clear every process cache (the bench's cold reset) ->
+    re-query: the alignment must come back from the on-disk factorize /
+    composite sidecars bit-identically, for both single- and multi-key."""
+    from bqueryd_tpu.storage.ctable import free_cachemem
+
+    df, tables = sharded
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    for gcols in (["passenger_count"], ["VendorID", "payment_type"]):
+        query = GroupByQuery(
+            gcols, [["fare_amount", "sum", "s"]], [], aggregate=True
+        )
+        warm = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads([ex.execute(tables, query)])
+        )
+        # sidecars must exist next to the first shard now
+        first = tables[0].rootdir
+        assert os.path.isfile(
+            os.path.join(first, "cols", gcols[0], "factor.npz")
+        )
+        ex.clear_caches()
+        free_cachemem()
+        # poison the factorizer: the cold query must be served entirely by
+        # the sidecars, or an always-miss load regression could hide behind
+        # a bit-identical recompute
+        from bqueryd_tpu import ops as ops_mod
+
+        real_factorize = ops_mod.factorize
+        ops_mod.factorize = lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("cold align recomputed instead of sidecar hit")
+        )
+        try:
+            cold = hostmerge.payload_to_dataframe(
+                hostmerge.merge_payloads([ex.execute(tables, query)])
+            )
+        finally:
+            ops_mod.factorize = real_factorize
+        assert_frames_match(cold, warm, gcols)
+        expected = (
+            df.groupby(gcols, as_index=False)["fare_amount"]
+            .sum()
+            .rename(columns={"fare_amount": "s"})
+        )
+        assert_frames_match(cold, expected, gcols)

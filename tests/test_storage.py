@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -189,3 +191,100 @@ def test_ctable_inconsistent_meta_rejected(tmp_path):
     json.dump(meta, open(root + "/meta.json", "w"))
     with pytest.raises(IOError):
         ctable(root, mode="r", auto_cache=False).column_raw("x")
+
+
+def test_factor_cache_sidecar_roundtrip_and_invalidation(tmp_path):
+    """The on-disk factorize sidecar (bquery auto_cache parity) round-trips,
+    is skipped when disabled, and invalidates when the column data changes."""
+    import pandas as pd
+
+    from bqueryd_tpu.models.query import QueryEngine
+
+    root = str(tmp_path / "t.bcolzs")
+    values = np.array([5, 5, 9, -3, 9, 5], dtype=np.int64)
+    ctable.fromdataframe(pd.DataFrame({"k": values}), root)
+    ct = ctable(root, mode="r")
+
+    engine = QueryEngine()
+    codes, uniques = engine._key_codes(ct, "k")
+    sidecar = os.path.join(root, "cols", "k", "factor.npz")
+    assert os.path.isfile(sidecar), "factorize must persist next to the shard"
+
+    # a cold engine (fresh process analogue) loads the SAME factorization
+    # from disk without decoding the column.  Poison the factorizer so a
+    # silent load-path regression (always-miss) cannot hide behind a
+    # recompute that yields identical output.
+    from bqueryd_tpu import ops as ops_mod
+
+    real_factorize = ops_mod.factorize
+    ops_mod.factorize = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("cold path recomputed instead of hitting the sidecar")
+    )
+    try:
+        cold = QueryEngine()
+        c2, u2 = cold._key_codes(ctable(root, mode="r"), "k")
+    finally:
+        ops_mod.factorize = real_factorize
+    np.testing.assert_array_equal(np.asarray(c2), np.asarray(codes))
+    np.testing.assert_array_equal(u2, uniques)
+
+    # appending rewrites the data file -> stamp mismatch -> fresh factorize
+    ct_w = ctable(root, mode="a")
+    ct_w.append_dataframe(pd.DataFrame({"k": np.array([7], dtype=np.int64)}))
+    ct_w.flush()
+    c3, u3 = QueryEngine()._key_codes(ctable(root, mode="r"), "k")
+    assert len(c3) == 7 and 7 in np.asarray(u3)
+
+    # kill switch
+    os.environ["BQUERYD_TPU_DISK_FACTOR_CACHE"] = "0"
+    try:
+        assert ctable(root, mode="r").factor_cache_load("k") is None
+    finally:
+        del os.environ["BQUERYD_TPU_DISK_FACTOR_CACHE"]
+
+
+def test_factor_cache_stores_post_poison_codes(tmp_path):
+    """Null keys (NaN) are poisoned to -1 BEFORE the sidecar is written, so
+    a disk load must not resurrect them as live groups."""
+    import pandas as pd
+
+    from bqueryd_tpu.models.query import QueryEngine
+
+    root = str(tmp_path / "f.bcolzs")
+    vals = np.array([1.5, np.nan, 2.5, np.nan, 1.5])
+    ctable.fromdataframe(pd.DataFrame({"k": vals}), root)
+    codes, _ = QueryEngine()._key_codes(ctable(root, mode="r"), "k")
+    c2, u2 = QueryEngine()._key_codes(ctable(root, mode="r"), "k")  # disk hit
+    np.testing.assert_array_equal(np.asarray(c2), np.asarray(codes))
+    assert (np.asarray(c2)[[1, 3]] == -1).all()
+    assert not np.isnan(np.asarray(u2)[np.asarray(c2)[[0, 2, 4]]]).any()
+
+
+def test_composite_cache_digest_guards_shard_set(tmp_path):
+    """The composite sidecar must refuse a hit when the global-dictionary
+    digest changes (same shard, different shard SET)."""
+    import pandas as pd
+
+    root = str(tmp_path / "c.bcolzs")
+    ctable.fromdataframe(
+        pd.DataFrame(
+            {
+                "a": np.array([0, 1, 0, 1], dtype=np.int64),
+                "b": np.array([2, 3, 3, 2], dtype=np.int64),
+            }
+        ),
+        root,
+    )
+    ct = ctable(root, mode="r")
+    codes = np.array([0, 3, 1, 2], dtype=np.int32)
+    uniq = np.array([0, 5, 7, 3], dtype=np.int64)
+    ct.composite_cache_store(
+        ["a", "b"], b"digest-one", codes, uniq,
+        stamp=ct.composite_stamp(["a", "b"]),
+    )
+    hit = ct.composite_cache_load(["a", "b"], b"digest-one")
+    assert hit is not None
+    np.testing.assert_array_equal(hit[0], codes)
+    np.testing.assert_array_equal(hit[1], uniq)
+    assert ct.composite_cache_load(["a", "b"], b"digest-two") is None
+    assert ct.composite_cache_load(["b", "a"], b"digest-one") is None
