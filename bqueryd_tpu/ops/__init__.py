@@ -31,6 +31,47 @@ if _requested and "axon" not in _requested and "tpu" not in _requested:
         pass
 del _requested
 
+# Persistent compilation cache: first-query-per-shape XLA compiles (20-40 s
+# per program through a tunneled TPU backend, plus tunnel round-trips)
+# survive process restarts.  Multi-process safe (atomic renames); every
+# (ops, dtypes, n_groups-bucket) signature a worker has ever served warms
+# the whole fleet's next restart.  TPU-ish platforms only: reloading
+# XLA:CPU AOT artifacts logs machine-feature-mismatch errors and documents
+# SIGILL risk on heterogeneous fleets, and CPU first-compiles are cheap
+# enough to just pay.  The platform is sniffed from env, NOT
+# jax.default_backend() — touching the backend at import time can hang on
+# a dead tunnel.  BQUERYD_TPU_COMPILE_CACHE=0 disables; =<path> relocates
+# (and also opts a CPU platform in, for tests).
+_cc = os.environ.get("BQUERYD_TPU_COMPILE_CACHE", "1")
+# same override precedence as the platform block above; with neither set,
+# fail CLOSED unless the axon tunnel boot already registered itself — a
+# bare CPU box must not persist XLA:CPU AOT artifacts by default (shared
+# homes across heterogeneous CPUs risk the SIGILL scenario above)
+_platf = (
+    os.environ.get("BQUERYD_TPU_PLATFORM")
+    or os.environ.get("JAX_PLATFORMS")
+    or ""
+)
+_tpuish = (
+    "tpu" in _platf
+    or "axon" in _platf
+    or (not _platf and "_AXON_REGISTERED" in os.environ)
+)
+if _cc != "0" and (_tpuish or _cc not in ("", "1")):
+    _cc_dir = _cc if _cc not in ("", "1") else os.path.join(
+        os.path.expanduser("~"), ".cache", "bqueryd_tpu", "jax_cache"
+    )
+    try:
+        os.makedirs(_cc_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cc_dir)
+        # cache every compile (the default 1 s floor would skip most of the
+        # small per-shape programs whose aggregate warmup this kills)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        pass  # unwritable home: first-compile cost stays, nothing breaks
+del _cc, _platf, _tpuish
+
 
 _distributed_initialized = False
 

@@ -807,3 +807,44 @@ def test_native_minmax_shares_one_pass(monkeypatch):
         sel = codes == gi
         assert int(out["aggs"][0]["min"][gi]) == vals[sel].min()
         assert int(out["aggs"][1]["max"][gi]) == vals[sel].max()
+
+
+def test_compile_cache_platform_gating(tmp_path):
+    """The persistent compile cache stays OFF on explicit CPU platforms
+    (XLA:CPU AOT reload logs feature-mismatch errors / SIGILL risk) and an
+    explicit path opts in anywhere.  Subprocesses: the config is process-
+    wide and latched at ops import."""
+    import os
+    import subprocess
+    import sys
+
+    def probe(extra_env):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+        for leak in (
+            "_AXON_REGISTERED",
+            "BQUERYD_TPU_PLATFORM",
+            "BQUERYD_TPU_COMPILE_CACHE",
+        ):
+            if leak not in extra_env:
+                env.pop(leak, None)
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax._src.xla_bridge as xb\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "xb._backend_factories.pop('axon', None)\n"
+                "from bqueryd_tpu import ops\n"
+                "print(repr(jax.config.jax_compilation_cache_dir))",
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        return out.stdout.strip().splitlines()[-1]
+
+    assert probe({}) == "None"
+    opt_in = str(tmp_path / "cc")
+    assert probe({"BQUERYD_TPU_COMPILE_CACHE": opt_in}) == repr(opt_in)
