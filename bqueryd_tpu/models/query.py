@@ -630,12 +630,20 @@ class QueryEngine:
                 else:
                     import jax
 
+                    # bucketed group count (ops.program_bucket): program
+                    # reuse across cardinality drift; padded groups are
+                    # zero-row and sliced off after the fetch
+                    n_prog = ops.program_bucket(n_groups)
                     partials = jax.device_get(  # ONE batched D2H round-trip
                         ops.partial_tables(
-                            dense.astype(np.int32), measures, mops, n_groups,
+                            dense.astype(np.int32), measures, mops, n_prog,
                             mask_arr, null_sentinels=sentinels,
                         )
                     )
+                    if n_prog != n_groups:
+                        partials = jax.tree_util.tree_map(
+                            lambda a: a[:n_groups], partials
+                        )
                 rows = partials["rows"]
                 for (i, _a), part in zip(mergeable, partials["aggs"]):
                     agg_parts[i] = dict(part)
@@ -646,10 +654,10 @@ class QueryEngine:
                         dense.astype(np.int32),
                         (np.zeros(len(dense)),),
                         ("count",),
-                        n_groups,
+                        ops.program_bucket(n_groups),
                         mask_arr,
                     )["rows"]
-                )
+                )[:n_groups]
             for i, agg in distinct:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)
@@ -661,11 +669,17 @@ class QueryEngine:
                     counts = ops.groupby_count_distinct(
                         dense.astype(np.int32),
                         np.asarray(vcodes),
-                        n_groups,
-                        max(len(vuniques), 1),
+                        ops.program_bucket(n_groups),
+                        # bucketing n_values keeps the composite mapping
+                        # injective (codes < actual < bucket), so distinct
+                        # counts are unchanged while the program shape
+                        # survives value-cardinality drift
+                        ops.program_bucket(max(len(vuniques), 1)),
                         mask_arr,
                     )
-                    agg_parts[i] = {"distinct": np.asarray(counts)}
+                    agg_parts[i] = {
+                        "distinct": np.asarray(counts)[:n_groups]
+                    }
                 elif op == "count_distinct":
                     # ship the per-group distinct VALUE SETS, not counts:
                     # sets union exactly across shards/workers, where the
@@ -702,9 +716,12 @@ class QueryEngine:
                     # run-boundary counts are inherently per-shard (the sort
                     # order is local); cross-shard merge stays additive
                     counts = ops.groupby_sorted_count_distinct(
-                        dense.astype(np.int32), vals, n_groups, mask_arr
+                        dense.astype(np.int32), vals,
+                        ops.program_bucket(n_groups), mask_arr,
                     )
-                    agg_parts[i] = {"distinct": np.asarray(counts)}
+                    agg_parts[i] = {
+                        "distinct": np.asarray(counts)[:n_groups]
+                    }
                 else:
                     raise ValueError(f"unknown aggregation op {op!r}")
 

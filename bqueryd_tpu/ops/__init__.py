@@ -128,6 +128,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     groupby_sorted_count_distinct,
     host_partial_tables,
     partial_tables,
+    program_bucket,
     psum_partials,
 )
 from bqueryd_tpu.ops.predicates import (  # noqa: E402
@@ -152,6 +153,7 @@ __all__ = [
     "expand_mask_by_group",
     "host_partial_tables",
     "partial_tables",
+    "program_bucket",
     "combine_partials",
     "psum_partials",
     "finalize",

@@ -214,6 +214,25 @@ def _int64_segment_sum(values, valid, safe, n_groups):
 _MATMUL_BLOCK = 32768
 
 
+def program_bucket(n, fine=False):
+    """Round a program-shape dimension UP onto a coarse grid so XLA programs
+    are reused across data refreshes and cardinality drift.
+
+    Static shapes are the TPU contract: every exact (rows, groups) pair is
+    its own compile, which costs 20-40 s per program through a tunneled
+    backend — while real serving data drifts a few percent per refresh.
+    Grid: pow2/64 steps for row counts (``fine=True``, <=~3.2% padding) and
+    pow2/16 for group counts (<=~12.5%, typically ~5%).  Padded groups get
+    zero rows and are sliced off by callers after fetch; padded rows carry
+    code -1 and vanish from every reduction.  BQUERYD_TPU_SHAPE_BUCKETS=0
+    disables (exact shapes, maximum compiles)."""
+    n = int(n)
+    if n <= 16 or os.environ.get("BQUERYD_TPU_SHAPE_BUCKETS", "1") == "0":
+        return max(n, 0)
+    step = 1 << max((n - 1).bit_length() - (6 if fine else 4), 0)
+    return -(-n // step) * step
+
+
 def matmul_groups_limit():
     """Above this group cardinality the one-hot matmul's N*G FLOPs cost more
     than the scatter it replaces (crossover ~8-16k groups at 10 M rows on

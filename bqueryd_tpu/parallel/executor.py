@@ -429,8 +429,15 @@ class MeshQueryExecutor:
                 if len(arrays) > 1
                 else arrays[0].dtype
             )
+        from bqueryd_tpu import ops
+
         total = sum(len(a) for a in arrays)
-        width = max(-(-total // n_devices), 1)
+        # bucketed per-device width (ops.program_bucket): row-count drift
+        # across data refreshes reuses the compiled program; padded rows
+        # carry the pad code (-1 for codes) and drop from every reduction
+        width = ops.program_bucket(
+            max(-(-total // n_devices), 1), fine=True
+        )
         out = np.full(n_devices * width, pad, dtype=dtype)
         off = 0
         for arr in arrays:
@@ -597,11 +604,21 @@ class MeshQueryExecutor:
             # whole merged pytree comes back as ONE device buffer — per-leaf
             # pulls cost a full transport round-trip each on tunneled/remote
             # devices
+            # the program computes over the BUCKETED group count (shape
+            # reuse across cardinality drift, ops.program_bucket); padded
+            # groups have zero rows and are sliced off right below, on host
+            n_prog = ops.program_bucket(n_groups)
             merged = _mesh_partials(
-                mesh, self.axis_name, query.ops, n_groups,
+                mesh, self.axis_name, query.ops, n_prog,
                 codes_d, tuple(measures_d),
                 null_sentinels=sentinels,
             )
+            if n_prog != n_groups:
+                import jax as _jax
+
+                merged = _jax.tree_util.tree_map(
+                    lambda a: a[:n_groups], merged
+                )
 
         with self._phase("collect"):
             rows = merged["rows"]

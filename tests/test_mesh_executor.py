@@ -404,3 +404,61 @@ def test_cold_path_hits_disk_sidecars_and_matches(sharded, mesh):
             .rename(columns={"fare_amount": "s"})
         )
         assert_frames_match(cold, expected, gcols)
+
+
+def test_program_bucket_properties():
+    from bqueryd_tpu import ops
+
+    for n in (1, 9, 16, 17, 100, 1000, 70225, 10_000_000):
+        for fine in (False, True):
+            b = ops.program_bucket(n, fine=fine)
+            assert b >= n
+            # bounded padding: <=12.5% coarse, <=3.2% fine (+1 step slack)
+            limit = 1.032 if fine else 1.13
+            assert n <= 16 or b <= int(n * limit) + 1, (n, fine, b)
+            # stability: the whole step maps to one bucket
+            assert ops.program_bucket(b, fine=fine) == b
+
+
+def test_group_drift_reuses_compiled_program(tmp_path, mesh):
+    """Two queries whose group counts differ but land in the same bucket
+    must share one compiled mesh program — the point of shape bucketing
+    (every exact cardinality was its own 20-40s compile on a tunneled
+    backend)."""
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    dfs = []
+    for n_vals in (900, 905):  # both bucket to the same grid point
+        rng = np.random.default_rng(n_vals)
+        dfs.append(
+            pd.DataFrame(
+                {
+                    "g": rng.integers(0, n_vals, 20_000).astype(np.int64),
+                    "v": rng.integers(-100, 100, 20_000).astype(np.int64),
+                }
+            )
+        )
+    tables = []
+    for i, df in enumerate(dfs):
+        root = str(tmp_path / f"drift_{i}.bcolzs")
+        ctable.fromdataframe(df, root)
+        tables.append(ctable(root, mode="r"))
+
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    query = GroupByQuery(["g"], [["v", "sum", "s"]], [], aggregate=True)
+    before = ex_mod._mesh_program.cache_info()
+    for df, t in zip(dfs, tables):
+        got = hostmerge.payload_to_dataframe(
+            hostmerge.merge_payloads([ex.execute([t], query)])
+        ).sort_values("g").reset_index(drop=True)
+        expected = (
+            df.groupby("g", as_index=False)["v"].sum()
+            .rename(columns={"v": "s"})
+        )
+        assert_frames_match(got, expected, ["g"])
+    after = ex_mod._mesh_program.cache_info()
+    assert after.misses == before.misses + 1, (
+        "group-count drift within one bucket must not recompile "
+        f"(before={before}, after={after})"
+    )
+    assert after.hits >= before.hits + 1
