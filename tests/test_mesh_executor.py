@@ -462,3 +462,30 @@ def test_group_drift_reuses_compiled_program(tmp_path, mesh):
         f"(before={before}, after={after})"
     )
     assert after.hits >= before.hits + 1
+
+
+def test_threaded_alignment_matches_sequential(sharded, mesh, monkeypatch):
+    """BQUERYD_TPU_ALIGN_THREADS>1 must produce the identical alignment as
+    the sequential path (single-core CI degrades to sequential silently, so
+    force the pool on)."""
+    df, tables = sharded
+    for gcols in (["passenger_count"], ["VendorID", "payment_type"]):
+        query = GroupByQuery(
+            gcols, [["fare_amount", "sum", "s"]], [], aggregate=True
+        )
+        monkeypatch.setenv("BQUERYD_TPU_ALIGN_THREADS", "1")
+        seq = MeshQueryExecutor(mesh=make_mesh())._global_key_space(
+            tables, query, QueryEngine()
+        )
+        monkeypatch.setenv("BQUERYD_TPU_ALIGN_THREADS", "4")
+        par = MeshQueryExecutor(mesh=make_mesh())._global_key_space(
+            tables, query, QueryEngine()
+        )
+        s_dense, s_combos, s_cards, s_vals = seq
+        p_dense, p_combos, p_cards, p_vals = par
+        assert s_cards == p_cards
+        np.testing.assert_array_equal(s_combos, p_combos)
+        for a, b in zip(s_dense, p_dense):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for col in s_vals:
+            np.testing.assert_array_equal(s_vals[col], p_vals[col])
