@@ -1018,7 +1018,12 @@ def expand_mask_by_group(group_codes, mask, n_groups=None):
     group_codes = jnp.asarray(group_codes)
     if n_groups is None:
         n_groups = group_codes.shape[0]
-    return _expand_mask_jit(group_codes, jnp.asarray(mask), int(n_groups))
+    # bucketed (program_bucket): basket cardinality drifts per shard and per
+    # refresh; the output is row-shaped, so padding the segment table needs
+    # no slicing — padded groups are simply never hit
+    return _expand_mask_jit(
+        group_codes, jnp.asarray(mask), program_bucket(int(n_groups))
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups",))
