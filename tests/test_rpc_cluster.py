@@ -479,3 +479,110 @@ def test_readfile_rejects_path_traversal(cluster):
 
     with pytest.raises(RPCError, match="escapes data_dir"):
         cluster["rpc"].readfile("../../etc/hostname")
+
+
+def test_replacement_worker_first_query_rides_disk_sidecars(tmp_path):
+    """A replacement worker's FIRST query on shards a previous worker served
+    must come back exact and be answered from the on-disk factorize
+    sidecars (bquery auto_cache parity across worker restarts): the
+    sidecars' mtimes must not change — a store only happens on a load
+    miss, so unchanged files mean the cold alignment truly loaded."""
+    import glob
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage import ctable as CT
+    from bqueryd_tpu.worker import WorkerNode
+
+    df = taxi_like_df(n=6_000, seed=9)
+    for i in range(3):
+        CT.fromdataframe(
+            df.iloc[i::3].reset_index(drop=True),
+            str(tmp_path / f"side-{i}.bcolzs"),
+        )
+    url = f"mem://sidecar-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.1,
+        dead_worker_timeout=2.0,
+    )
+
+    def new_worker():
+        return WorkerNode(
+            coordination_url=url,
+            data_dir=str(tmp_path),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.1,
+            poll_timeout=0.05,
+        )
+
+    files = [f"side-{i}.bcolzs" for i in range(3)]
+    expected = (
+        df.groupby("payment_type")["total_amount"].sum().to_dict()
+    )
+    w1 = new_worker()
+    nodes = [controller, w1]
+    threads = [
+        threading.Thread(target=n.go, daemon=True) for n in nodes
+    ]
+    for t in threads:
+        t.start()
+    try:
+        wait_until(
+            lambda: all(f in controller.files_map for f in files),
+            desc="registration",
+        )
+        rpc = RPC(coordination_url=url, timeout=30,
+                  loglevel=logging.WARNING)
+        got = rpc.groupby(
+            files, ["payment_type"], [["total_amount", "sum", "s"]], []
+        )
+        assert dict(
+            zip(got["payment_type"], got["s"])
+        ) == pytest.approx(expected)
+
+        sidecars = sorted(
+            glob.glob(str(tmp_path / "side-*" / "cols" / "*" / "*.npz"))
+        )
+        assert sidecars, "first worker must have persisted factorizations"
+        stamps_before = [os.stat(p).st_mtime_ns for p in sidecars]
+
+        # hard restart: silence + replacement (fresh engine, empty caches)
+        w1.send = lambda *a, **k: None
+        w1._hb_stop.set()
+        w1.running = False
+        w2 = new_worker()
+        nodes.append(w2)
+        t2 = threading.Thread(target=w2.go, daemon=True)
+        threads.append(t2)
+        t2.start()
+        wait_until(
+            lambda: w2.worker_id in controller.worker_map
+            and w1.worker_id not in controller.worker_map,
+            timeout=20,
+            desc="replacement adopted, old culled",
+        )
+        # a DIFFERENT aggregation over the same key column: no result
+        # cache anywhere can serve it, so it must run on the replacement —
+        # while key alignment still rides the same factorize sidecars
+        got2 = rpc.groupby(
+            files, ["payment_type"], [["total_amount", "mean", "m"]], []
+        )
+        expected_mean = (
+            df.groupby("payment_type")["total_amount"].mean().to_dict()
+        )
+        assert dict(
+            zip(got2["payment_type"], got2["m"])
+        ) == pytest.approx(expected_mean)
+        stamps_after = [os.stat(p).st_mtime_ns for p in sidecars]
+        assert stamps_after == stamps_before, (
+            "replacement worker re-factorized instead of loading sidecars"
+        )
+    finally:
+        for n in nodes:
+            n.running = False
+        for t in threads:
+            t.join(timeout=5)
