@@ -51,10 +51,114 @@ def main():
     report = {
         "backend": jax.default_backend(),
         "n_devices": len(jax.devices()),
+        "kernel_bench": {},
         "cases": {},
         "f64_large": None,
         "ok": False,
     }
+
+    # ---- kernel micro-bench FIRST: the scarcest evidence (a tunnel window
+    # can be minutes) is per-kernel hardware walls at bench shapes,
+    # including one REAL (non-interpret) Pallas run — no cluster needed.
+    # Each case is gated on numpy ground truth so a wrong-route or wrong-
+    # result kernel can't post a number.
+    def kernel_bench():
+        import jax.numpy  # noqa: F401  (backend bring-up)
+
+        from bqueryd_tpu.ops import groupby as gb
+
+        rng = np.random.default_rng(0)
+        # pre-set route flags would silently re-route the non-pallas cases
+        # (flags are read per call in the un-jitted dispatcher); pop them
+        # for the whole bench and restore after (same hygiene as bench.py)
+        prior_env = {
+            flag: os.environ.pop(flag, None)
+            for flag in ("BQUERYD_TPU_PALLAS", "BQUERYD_TPU_FORCE_MATMUL")
+        }
+        shapes = [
+            # (name, rows, groups, op, dtype, pallas)
+            ("sum_i64_1M_9g", 1_000_000, 9, "sum", np.int64, False),
+            ("sum_i64_10M_9g", 10_000_000, 9, "sum", np.int64, False),
+            ("mean_f64_10M_9g", 10_000_000, 9, "mean", np.float64, False),
+            ("sum_i64_10M_70225g", 10_000_000, 70_225, "sum", np.int64,
+             False),
+            ("sum_i64_10M_9g_pallas", 10_000_000, 9, "sum", np.int64,
+             True),
+        ]
+        for name, n, g, op, dt, use_pallas in shapes:
+            if use_pallas and jax.default_backend() == "cpu":
+                # same honesty rule as bench.py: off-TPU the flag would
+                # re-measure the scatter path under a pallas label
+                report["kernel_bench"][name] = {
+                    "skipped": "needs a tpu backend"
+                }
+                continue
+            try:
+                codes = rng.integers(0, g, n).astype(np.int64)
+                if dt == np.float64:
+                    vals = (rng.random(n) * 100 - 50).astype(dt)
+                else:
+                    vals = rng.integers(-1000, 1000, n).astype(dt)
+                if use_pallas:
+                    os.environ["BQUERYD_TPU_PALLAS"] = "1"
+                try:
+                    t_h2d = time.perf_counter()
+                    codes_d = jax.device_put(codes)
+                    vals_d = jax.device_put(vals)
+                    jax.block_until_ready((codes_d, vals_d))
+                    h2d_s = time.perf_counter() - t_h2d
+                    t_first = time.perf_counter()
+                    r = gb.partial_tables(codes_d, (vals_d,), (op,), g)
+                    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+                    first_s = time.perf_counter() - t_first
+                    walls = []
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        r = gb.partial_tables(
+                            codes_d, (vals_d,), (op,), g
+                        )
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(r)
+                        )
+                        walls.append(time.perf_counter() - t0)
+                finally:
+                    if use_pallas:
+                        os.environ.pop("BQUERYD_TPU_PALLAS", None)
+                got = np.asarray(r["aggs"][0]["sum"])  # mean partials: sum
+                truth = np.zeros(g, dtype=np.float64 if dt == np.float64
+                                 else np.int64)
+                with np.errstate(over="ignore"):
+                    np.add.at(truth, codes, vals)
+                if dt == np.float64:
+                    exact = bool(np.allclose(got, truth, rtol=1e-9))
+                else:
+                    exact = bool((got == truth).all())
+                report["kernel_bench"][name] = {
+                    "wall_s": round(min(walls), 5),
+                    "rows_per_sec": round(n / min(walls), 1),
+                    "h2d_s": round(h2d_s, 3),
+                    "compile_plus_first_s": round(first_s, 2),
+                    "exact": exact,
+                }
+            except Exception:
+                report["kernel_bench"][name] = {
+                    "error": traceback.format_exc(limit=2)
+                }
+            print(
+                f"[tpu_validate] kernel {name}: "
+                f"{report['kernel_bench'][name]}",
+                file=sys.stderr,
+                flush=True,
+            )
+            # checkpoint after every kernel so a wedging tunnel still
+            # leaves the completed entries on disk
+            with open(out_path, "w") as f:
+                json.dump(report, f, indent=1)
+        for flag, prior in prior_env.items():
+            if prior is not None:
+                os.environ[flag] = prior
+
+    kernel_bench()
 
     import test_differential_fuzz as fz
     from bqueryd_tpu.models.query import GroupByQuery, QueryEngine
@@ -160,6 +264,11 @@ def main():
         failures += 1
         report["f64_large"] = {"error": traceback.format_exc(limit=3)}
 
+    failures += sum(
+        1
+        for v in report["kernel_bench"].values()
+        if "error" in v or v.get("exact") is False
+    )
     report["ok"] = failures == 0
     report["failures"] = failures
     report["total_s"] = round(time.time() - t0, 1)
