@@ -13,6 +13,10 @@ NumPy merge + finalize (:mod:`bqueryd_tpu.parallel.hostmerge`).  Mean is a
 correct weighted mean; ``legacy_merge=True`` restores the reference's
 sum-of-shard-means quirk (reference bqueryd/rpc.py:171) for byte-compatible
 comparisons.
+
+An ``RPC`` instance wraps one zmq REQ socket and is therefore
+single-thread lockstep, exactly like the reference client: concurrent
+callers must each hold their own instance (they are cheap — one ping).
 """
 
 import logging

@@ -492,3 +492,97 @@ def test_full_distribution_pipeline_over_azure(pipeline, tmp_path):
     )
     expect = df.groupby("g")["v"].sum().to_dict()
     assert dict(zip(got["g"].tolist(), got["v_sum"].tolist())) == expect
+
+
+def test_concurrent_tickets_activate_exactly_once(
+    pipeline, tmp_path, mem_store_url
+):
+    """Three tickets in flight at once, eight shards each: every shard
+    activates exactly once with its own ticket's provenance, no
+    cross-ticket contamination, every shard queryable afterwards — the
+    two-phase commit under the concurrency the reference never tested."""
+    from bqueryd_tpu.download import METADATA_FILENAME
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.utils.net import zip_to_file
+
+    build = tmp_path / "cbuild"
+    build.mkdir()
+    frames = {}
+    tickets_files = []
+    for t in range(3):
+        files = []
+        for s in range(8):
+            name = f"ticket{t}_shard{s}.bcolzs"
+            df = pd.DataFrame(
+                {
+                    "g": np.arange(200, dtype=np.int64) % 5,
+                    "v": np.arange(200, dtype=np.int64) + 1000 * t + s,
+                }
+            )
+            frames[name] = df
+            src = build / name
+            ctable.fromdataframe(df, str(src))
+            zip_path, _ = zip_to_file(str(src), str(build))
+            pipeline["backend"].put("bcolz", f"{name}.zip", zip_path)
+            files.append(f"{name}.zip")
+        tickets_files.append(files)
+
+    # issue all three tickets concurrently — one RPC client per thread
+    # (an RPC wraps a zmq REQ socket, which is single-thread lockstep by
+    # design, exactly like the reference's client)
+    from bqueryd_tpu.rpc import RPC
+
+    results = {}
+    threads = []
+
+    def issue(i, files):
+        client = RPC(
+            coordination_url=mem_store_url,
+            timeout=90,
+            loglevel=logging.WARNING,
+        )
+        results[i] = client.download(
+            filenames=files, bucket="bcolz", wait=True, scheme="localfs",
+        )
+
+    for i, files in enumerate(tickets_files):
+        th = threading.Thread(target=issue, args=(i, files), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive(), "a ticket never completed"
+    # a thread that raised never recorded its result: fail HERE, not as a
+    # misleading activation timeout 30s later
+    assert len(results) == 3, f"ticket thread died: {results}"
+    assert all(r == "DONE" for r in results.values()), results
+
+    import json as _json
+
+    seen_tickets = {}
+    for name, df in frames.items():
+        activated = pipeline["serving"] / name
+        wait_until(activated.is_dir, desc=f"{name} activated")
+        meta = _json.loads((activated / METADATA_FILENAME).read_text())
+        seen_tickets.setdefault(meta["ticket"], set()).add(name)
+    # each ticket stamped exactly its own 8 shards
+    assert sorted(len(v) for v in seen_tickets.values()) == [8, 8, 8]
+    for tid, names in seen_tickets.items():
+        prefixes = {n.split("_")[0] for n in names}
+        assert len(prefixes) == 1, f"ticket {tid} mixed shards: {names}"
+
+    # every shard serves its own data
+    wait_until(
+        lambda: all(
+            n in pipeline["controller"].files_map for n in frames
+        ),
+        timeout=30,
+        desc="all shards advertised",
+    )
+    for name, df in list(frames.items())[::7]:  # spot-check across tickets
+        got = pipeline["rpc"].groupby(
+            [name], ["g"], [["v", "sum", "s"]], []
+        )
+        assert dict(zip(got["g"], got["s"])) == (
+            df.groupby("g")["v"].sum().to_dict()
+        ), name
