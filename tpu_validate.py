@@ -160,6 +160,67 @@ def main():
             # checkpoint after every kernel so a wedging tunnel still
             # leaves the completed entries on disk
             checkpoint()
+        # one MESH-program data point: the exact serving program (shard_map
+        # + psum merge + packed single-buffer fetch) on this backend's
+        # devices — distinct from the bare kernel above, which skips the
+        # collective and the packed fetch
+        name = "mesh_sum_i64_10M_9g"
+        try:
+            from bqueryd_tpu.parallel import executor as ex_mod
+
+            mesh = ex_mod.make_mesh()
+            n_dev = mesh.devices.size
+            n, g = 10_000_000, 9
+            codes = rng.integers(0, g, n).astype(np.int32)
+            vals = rng.integers(-1000, 1000, n).astype(np.int64)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(mesh, P("shards", None))
+            # the serving path narrows codes to _codes_dtype(g) (int8 at 9
+            # groups) and the dtype is part of the traced program: match it
+            # or this measures a different trace than serving runs
+            cdt = ex_mod._codes_dtype(g)
+            codes_p = ex_mod.MeshQueryExecutor._pack(
+                [codes.astype(cdt)], n_dev, cdt.type(-1), dtype=cdt
+            )
+            vals_p = ex_mod.MeshQueryExecutor._pack([vals], n_dev, 0)
+            codes_d = jax.device_put(codes_p, sharding)
+            vals_d = jax.device_put(vals_p, sharding)
+            t_first = time.perf_counter()
+            merged = ex_mod._mesh_partials(
+                mesh, "shards", ("sum",), g, codes_d, (vals_d,)
+            )
+            first_s = time.perf_counter() - t_first
+            walls = []
+            for _ in range(3):
+                t1 = time.perf_counter()
+                merged = ex_mod._mesh_partials(
+                    mesh, "shards", ("sum",), g, codes_d, (vals_d,)
+                )
+                walls.append(time.perf_counter() - t1)
+            truth = np.zeros(g, dtype=np.int64)
+            with np.errstate(over="ignore"):
+                np.add.at(truth, codes, vals)
+            exact = bool(
+                (np.asarray(merged["aggs"][0]["sum"]) == truth).all()
+            )
+            report["kernel_bench"][name] = {
+                "wall_s": round(min(walls), 5),
+                "rows_per_sec": round(n / min(walls), 1),
+                "n_devices": int(n_dev),
+                "compile_plus_first_s": round(first_s, 2),
+                "exact": exact,
+            }
+        except Exception:
+            report["kernel_bench"][name] = {
+                "error": traceback.format_exc(limit=2)
+            }
+        print(
+            f"[tpu_validate] kernel {name}: {report['kernel_bench'][name]}",
+            file=sys.stderr,
+            flush=True,
+        )
+        checkpoint()
         for flag, prior in prior_env.items():
             if prior is not None:
                 os.environ[flag] = prior
