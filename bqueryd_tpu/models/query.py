@@ -548,6 +548,7 @@ class QueryEngine:
             code_arrays = [np.asarray(c) for c, _ in per_key]
             key_values = [v for _, v in per_key]
             cards = [len(v) for v in key_values]
+            combo_cols = None  # set by the CompositeOverflow fallback only
             # Null keys (code -1, dict-encoded missing values) stay -1 in the
             # dense codes: every kernel treats negative codes as invalid, so
             # null-key rows vanish from the aggregation (pandas dropna
@@ -561,6 +562,28 @@ class QueryEngine:
                 dense = code_arrays[0]
                 combos = np.arange(cards[0], dtype=np.int64)
                 n_groups = max(cards[0], 1)
+            elif ops.total_cardinality(cards) >= ops.MAX_COMPOSITE:
+                # radix packing would wrap (CompositeOverflow): factorize
+                # the key TUPLES instead.  O(n log n) via a void-record
+                # unique, null rows (any component -1) poisoned up front.
+                # combos are not radix-decodable here, so the per-column
+                # codes of each combo ride along for collect.
+                stacked = np.stack(
+                    [np.asarray(c, dtype=np.int64) for c in code_arrays],
+                    axis=1,
+                )
+                valid = (stacked >= 0).all(axis=1)
+                view = np.ascontiguousarray(stacked[valid]).view(
+                    [("", np.int64)] * stacked.shape[1]
+                ).ravel()
+                uniq, inv = np.unique(view, return_inverse=True)
+                dense = np.full(len(stacked), np.int64(-1))
+                dense[valid] = inv
+                combo_cols = (
+                    uniq.view(np.int64).reshape(len(uniq), stacked.shape[1])
+                )
+                combos = np.arange(len(uniq), dtype=np.int64)
+                n_groups = max(len(uniq), 1)
             else:
                 packed = ops.pack_codes(code_arrays, cards)
                 total_card = ops.total_cardinality(cards)
@@ -731,6 +754,13 @@ class QueryEngine:
             keys = {}
             if len(query.groupby_cols) == 1:
                 key_codes = [combos_present]
+            elif combo_cols is not None:
+                # tuple-factorized combos (CompositeOverflow fallback):
+                # per-column codes were kept alongside, not radix-packed
+                key_codes = [
+                    combo_cols[np.asarray(combos_present), ci]
+                    for ci in range(combo_cols.shape[1])
+                ]
             else:
                 from bqueryd_tpu import ops as _ops
 

@@ -111,6 +111,8 @@ def maybe_init_distributed(logger=None):
 
 
 from bqueryd_tpu.ops.factorize import (  # noqa: E402
+    MAX_COMPOSITE,
+    CompositeOverflow,
     factorize,
     factorize_device,
     pack_codes,
@@ -140,6 +142,8 @@ from bqueryd_tpu.ops.predicates import (  # noqa: E402
 )
 
 __all__ = [
+    "CompositeOverflow",
+    "MAX_COMPOSITE",
     "factorize",
     "factorize_device",
     "pack_codes",

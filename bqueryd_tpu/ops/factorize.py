@@ -63,14 +63,33 @@ def factorize_device(keys, capacity, fill_value=None):
     return uniques, codes.astype(jnp.int32), n_uniques
 
 
+#: composite key spaces at or past this product cannot be radix-packed in
+#: int64; the single definition every overflow check compares against
+MAX_COMPOSITE = 2**63
+
+
+class CompositeOverflow(ValueError):
+    """The product of key cardinalities exceeds int64: radix-packed
+    composite codes would wrap and silently merge unrelated groups.
+    Callers degrade to tuple-wise factorization (engine path) or refuse
+    (mesh path, whose cross-shard alignment needs the radix order)."""
+
+
 def pack_codes(code_arrays, cardinalities):
     """Combine per-key dense codes into one composite code array.
 
     Works on NumPy or JAX arrays (pure arithmetic).  ``cardinalities[i]`` must
     bound ``code_arrays[i]`` (codes in ``[0, K_i)``); negative codes (nulls)
-    poison the whole composite to -1.
+    poison the whole composite to -1.  Raises :class:`CompositeOverflow`
+    when the composite space does not fit int64 (wrapping would corrupt
+    group identities, not just waste space).
     """
     assert len(code_arrays) == len(cardinalities) and code_arrays
+    if total_cardinality(cardinalities) >= MAX_COMPOSITE:  # py ints: no wrap
+        raise CompositeOverflow(
+            "composite group-key space "
+            f"{'x'.join(str(int(c)) for c in cardinalities)} exceeds int64"
+        )
     np_like = np if isinstance(code_arrays[0], np.ndarray) else _jnp()
     total = code_arrays[0].astype(np_like.int64)
     negative = code_arrays[0] < 0

@@ -343,6 +343,18 @@ class MeshQueryExecutor:
             key_values = dict(zip(query.groupby_cols, global_values))
             return dense, combos, cards, key_values
 
+        # guard BEFORE the composite sidecar loader: a sidecar stored by a
+        # build predating the overflow guard holds silently WRAPPED packs
+        # under the same dictionaries+cards digest — a cache hit must not
+        # resurrect corrupt composites.  (The mesh alignment needs the
+        # radix order, so past-int64 spaces degrade to the engine path at
+        # the worker.)
+        if ops.total_cardinality(cards) >= ops.MAX_COMPOSITE:
+            raise ops.CompositeOverflow(
+                "composite group-key space "
+                f"{'x'.join(str(int(c)) for c in cards)} exceeds int64"
+            )
+
         # multi-key: observed composites per shard via the native hash
         # factorizer (O(rows) per shard, small unique sets) instead of one
         # rows-scale sort-unique over the concatenated shards.  The result

@@ -586,6 +586,7 @@ class WorkerNode(WorkerBase):
             _host_ns_estimate,
             host_kernel_rows,
         )
+        from bqueryd_tpu import ops as ops_mod
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
@@ -608,7 +609,16 @@ class WorkerNode(WorkerBase):
             # per-shard engine path, whose execute_local picks the host
             # kernel (latency-aware routing, models.query.host_kernel_rows).
             self.mesh_executor.timer = timer
-            return self.mesh_executor.execute(tables, query)
+            try:
+                return self.mesh_executor.execute(tables, query)
+            except ops_mod.CompositeOverflow:
+                # the mesh alignment needs radix-packed composites; a key
+                # space past int64 degrades to the per-shard engine path,
+                # which factorizes key TUPLES instead of refusing the query
+                self.logger.info(
+                    "composite key space exceeds int64; serving via the "
+                    "per-shard engine path"
+                )
         if len(tables) == 1:
             self.engine.timer = timer
             return self.engine.execute_local(tables[0], query)
