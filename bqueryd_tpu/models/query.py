@@ -684,22 +684,29 @@ class QueryEngine:
             for i, agg in distinct:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)
+                counts = None
                 if op == "count_distinct" and query.sole_payload:
                     # single-shard query: this payload IS the final result,
                     # so the device sort kernel's per-group counts suffice
                     # (a device radix sort beats host np.unique at scale)
                     vcodes, vuniques = self._key_codes(table, in_col)
-                    counts = ops.groupby_count_distinct(
-                        dense.astype(np.int32),
-                        np.asarray(vcodes),
-                        ops.program_bucket(n_groups),
-                        # bucketing n_values keeps the composite mapping
-                        # injective (codes < actual < bucket), so distinct
-                        # counts are unchanged while the program shape
-                        # survives value-cardinality drift
-                        ops.program_bucket(max(len(vuniques), 1)),
-                        mask_arr,
-                    )
+                    try:
+                        counts = ops.groupby_count_distinct(
+                            dense.astype(np.int32),
+                            np.asarray(vcodes),
+                            ops.program_bucket(n_groups),
+                            # bucketing n_values keeps the composite
+                            # mapping injective (codes < actual < bucket),
+                            # so distinct counts are unchanged while the
+                            # program shape survives cardinality drift
+                            ops.program_bucket(max(len(vuniques), 1)),
+                            mask_arr,
+                        )
+                    except ops.CompositeOverflow:
+                        # (group, value) space past int64: the set-shipping
+                        # branch below answers exactly without packing
+                        pass
+                if counts is not None:
                     agg_parts[i] = {
                         "distinct": np.asarray(counts)[:n_groups]
                     }

@@ -986,6 +986,22 @@ def groupby_count_distinct(codes, value_codes, n_groups, n_values, mask=None):
     ``value_codes`` are dense codes of the measure values (host-factorized).
     Static shapes throughout: sort of [n], then a segment_sum of boundary
     flags.  O(n log n) but bandwidth-friendly on TPU."""
+    from bqueryd_tpu.ops.factorize import (
+        MAX_COMPOSITE,
+        CompositeOverflow,
+        total_cardinality,
+    )
+
+    if total_cardinality((n_groups, n_values)) >= MAX_COMPOSITE:
+        # static args: raises at trace time.  Both factors are bounded by
+        # row count, so this needs ~3e9-row single shards to fire — but a
+        # wrapped (group, value) composite would undercount distincts
+        # silently, which is never acceptable.  The engine degrades to the
+        # distinct-value-set path on this error.
+        raise CompositeOverflow(
+            f"count_distinct composite space {n_groups}x{n_values} "
+            "exceeds int64"
+        )
     valid = (codes >= 0) & (value_codes >= 0)
     if mask is not None:
         valid = valid & mask

@@ -955,3 +955,15 @@ def test_worker_degrades_mesh_overflow_to_engine(tmp_path, caplog):
     assert len(got) == len(exp)
     for c in got.columns:
         np.testing.assert_array_equal(got[c].to_numpy(), exp[c].to_numpy())
+
+
+def test_count_distinct_refuses_composite_overflow():
+    from bqueryd_tpu import ops
+
+    with pytest.raises(ops.CompositeOverflow, match="exceeds int64"):
+        ops.groupby_count_distinct(
+            np.zeros(4, dtype=np.int32),
+            np.zeros(4, dtype=np.int32),
+            2**32,
+            2**32,
+        )
