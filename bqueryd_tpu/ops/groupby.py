@@ -457,11 +457,25 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
                 # bits and each residual is exact in f32, so hi+mid+lo
                 # reconstructs all 24 f32 mantissa bits — the measure's
                 # REPRESENTATION on the MXU path is lossless and the only
-                # error left is the accumulation rounding any f32 sum has
-                hi = v.astype(jnp.bfloat16)
-                r1 = v - hi.astype(jnp.float32)
-                mid = r1.astype(jnp.bfloat16)
-                lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+                # error left is the accumulation rounding any f32 sum has.
+                # The rounding MUST be lax.reduce_precision, not an
+                # f32->bf16->f32 astype round-trip: on TPU the XLA
+                # excess-precision pass elides the round-trip, which turns
+                # r1 into v - v == 0 and silently drops the mid/lo limbs
+                # (~0.9% relative error, caught on hardware by
+                # tpu_validate.py; reduce_precision is contractually never
+                # folded away).
+                hi_f = lax.reduce_precision(v, exponent_bits=8,
+                                            mantissa_bits=7)
+                r1 = v - hi_f
+                mid_f = lax.reduce_precision(r1, exponent_bits=8,
+                                             mantissa_bits=7)
+                r2 = r1 - mid_f
+                hi = hi_f.astype(jnp.bfloat16)
+                mid = mid_f.astype(jnp.bfloat16)
+                lo = lax.reduce_precision(
+                    r2, exponent_bits=8, mantissa_bits=7
+                ).astype(jnp.bfloat16)
                 plans.append(
                     ("float_sum", op, add_float(hi), add_float(mid),
                      add_float(lo), present_row)

@@ -489,6 +489,41 @@ def test_host_partial_tables_float_sum_close():
     )
 
 
+def test_float_matmul_split_uses_reduce_precision(monkeypatch):
+    """The bf16 Dekker split on the MXU path must round via
+    lax.reduce_precision, never an f32->bf16->f32 astype round-trip: on
+    TPU the XLA excess-precision pass elides the round-trip, zeroing the
+    mid/lo limbs (~0.9% relative error on float sums — caught on real
+    hardware, TPU_VALIDATE_r5_prefix.json case5/case10).  The elision
+    never happens on the CPU test backend, so pin the structural
+    property instead: the traced program of a float-measure matmul
+    groupby must contain reduce_precision ops."""
+    import jax
+
+    monkeypatch.setenv("BQUERYD_TPU_FORCE_MATMUL", "1")
+    g = _groupby_module()
+    rng = np.random.default_rng(3)
+    n, ng = 4_096, 9
+    codes = rng.integers(0, ng, n).astype(np.int32)
+    vals = rng.standard_normal(n).astype(np.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda c, v: g._partial_tables_mm(c, (v,), ("sum",), ng)
+    )(codes, vals)
+    assert "reduce_precision" in str(jaxpr), (
+        "float matmul limbs no longer rounded via reduce_precision; "
+        "the TPU excess-precision elision bug can return"
+    )
+    # and the split is still a lossless representation end-to-end
+    out = jax.device_get(g.partial_tables(codes, (vals,), ("sum",), ng))
+    expected = np.zeros(ng)
+    np.add.at(expected, codes, vals.astype(np.float64))
+    np.testing.assert_allclose(
+        np.asarray(out["aggs"][0]["sum"], dtype=np.float64),
+        expected,
+        rtol=2e-6,
+    )
+
+
 def test_host_kernel_rows_env_and_cap(monkeypatch):
     from bqueryd_tpu.models import query as q
 
