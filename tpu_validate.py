@@ -160,6 +160,58 @@ def main():
             # checkpoint after every kernel so a wedging tunnel still
             # leaves the completed entries on disk
             checkpoint()
+        # route-tuning data point: the SORT+prefix-diff path at the
+        # highcard bench shape.  The dispatcher picks the blocked scatter
+        # here (n_blocks*groups fits _MAX_BLOCK_SEGMENTS); measuring the
+        # sorted path next to it on hardware tells us whether the 70k-group
+        # crossover belongs lower on this chip (pre-fix hardware sample:
+        # blocked path 0.583 s at this shape — thin margin vs the 0.833 s
+        # baseline).
+        name = "sum_i64_10M_70225g_sorted"
+        try:
+            import jax.numpy as jnp
+
+            n, g = 10_000_000, 70_225
+            codes = rng.integers(0, g, n).astype(np.int64)
+            vals = rng.integers(-1000, 1000, n).astype(np.int64)
+
+            @jax.jit
+            def _sorted(c, v):
+                safe = c.astype(jnp.int32)
+                return gb._sorted_segment_sum(v, safe, g)
+
+            codes_d = jax.device_put(codes)
+            vals_d = jax.device_put(vals)
+            jax.block_until_ready((codes_d, vals_d))
+            t_first = time.perf_counter()
+            r = _sorted(codes_d, vals_d)
+            jax.block_until_ready(r)
+            first_s = time.perf_counter() - t_first
+            walls = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                r = _sorted(codes_d, vals_d)
+                jax.block_until_ready(r)
+                walls.append(time.perf_counter() - t0)
+            truth = np.zeros(g, dtype=np.int64)
+            np.add.at(truth, codes, vals)
+            report["kernel_bench"][name] = {
+                "wall_s": round(min(walls), 5),
+                "rows_per_sec": round(n / min(walls), 1),
+                "compile_plus_first_s": round(first_s, 2),
+                "exact": bool((np.asarray(r) == truth).all()),
+            }
+        except Exception:
+            report["kernel_bench"][name] = {
+                "error": traceback.format_exc(limit=2)
+            }
+        print(
+            f"[tpu_validate] kernel {name}: {report['kernel_bench'][name]}",
+            file=sys.stderr,
+            flush=True,
+        )
+        checkpoint()
+
         # one MESH-program data point: the exact serving program (shard_map
         # + psum merge + packed single-buffer fetch) on this backend's
         # devices — distinct from the bare kernel above, which skips the
