@@ -38,6 +38,7 @@ and H2D entirely and costs one compiled kernel dispatch.
 
 import functools
 import os
+import time
 
 import numpy as np
 
@@ -620,11 +621,27 @@ class MeshQueryExecutor:
             # reuse across cardinality drift, ops.program_bucket); padded
             # groups have zero rows and are sliced off right below, on host
             n_prog = ops.program_bucket(n_groups)
-            merged = _mesh_partials(
-                mesh, self.axis_name, query.ops, n_prog,
-                codes_d, tuple(measures_d),
-                null_sentinels=sentinels,
-            )
+            # tunneled backends surface transient remote-compile INTERNAL
+            # errors (HTTP 500 compile-helper crashes observed on hardware,
+            # TPU_VALIDATE_r5_prefix.json case7/case13): one retry keeps
+            # the on-device merge path; a second failure propagates to the
+            # worker, which degrades to the per-shard engine path
+            for attempt in range(2):
+                try:
+                    merged = _mesh_partials(
+                        mesh, self.axis_name, query.ops, n_prog,
+                        codes_d, tuple(measures_d),
+                        null_sentinels=sentinels,
+                    )
+                    break
+                except jax.errors.JaxRuntimeError as exc:
+                    # deterministic failures (INVALID_ARGUMENT, device OOM)
+                    # would fail identically: propagate at once and let the
+                    # worker degrade, keeping the sleep out of their path
+                    # (and out of the aggregate-phase timing)
+                    if attempt or not _transient_status(exc):
+                        raise
+                    time.sleep(0.5)
             if n_prog != n_groups:
                 import jax as _jax
 
@@ -766,6 +783,31 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
 #: later queries go straight to the per-leaf fetch
 _packed_fetch_broken = False
 
+#: consecutive transiently-classed packed-fetch failures; once it reaches
+#: _PACKED_TRANSIENT_LIMIT the "transient" diagnosis is abandoned and the
+#: per-leaf latch sets anyway (an XLA lowering bug classed INTERNAL would
+#: otherwise dodge the latch forever, costing every query two failed packed
+#: dispatches and an engine degrade)
+_packed_transient_count = 0
+_PACKED_TRANSIENT_LIMIT = 3
+
+#: gRPC-style status prefixes a flaky tunneled backend surfaces for
+#: infrastructure (retry-worthy) failures, as opposed to deterministic
+#: program rejections (INVALID_ARGUMENT, UNIMPLEMENTED, FAILED_PRECONDITION)
+#: or deterministic resource exhaustion.  Observed on hardware: remote
+#: compile-helper crashes arrive as "INTERNAL: ... HTTP 500"
+#: (TPU_VALIDATE_r5_prefix.json case7/case13).
+_TRANSIENT_STATUSES = (
+    "INTERNAL", "UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED", "UNKNOWN"
+)
+
+
+def _transient_status(exc):
+    """Whether a JaxRuntimeError looks like transient infrastructure failure
+    (worth one in-place retry) rather than a deterministic rejection."""
+    msg = str(exc)
+    return any(s in msg for s in _TRANSIENT_STATUSES)
+
 
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
                    null_sentinels=None):
@@ -784,23 +826,58 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             null_sentinels,  # part of the lru key: it changes the trace
         )
 
+    global _packed_transient_count
+    latch_pending = False
     if pack:
         try:
             program, spec = run(True)
             out = program(codes_d, *measures_d)
             flat = np.asarray(jax.device_get(out))
-        except Exception:
+        except Exception as exc:
+            if (
+                isinstance(exc, jax.errors.JaxRuntimeError)
+                and _transient_status(exc)
+                and _packed_transient_count + 1 < _PACKED_TRANSIENT_LIMIT
+            ):
+                # transient infrastructure error (tunneled backends surface
+                # flaky remote-compile HTTP 500s as INTERNAL, dropped links
+                # as UNAVAILABLE): NOT evidence against packing — re-raise
+                # so the caller's retry re-attempts the packed program
+                # instead of latching the process into per-leaf fetch (one
+                # transport round-trip per result leaf) forever.  A
+                # DETERMINISTIC failure that happens to carry a transient
+                # status (e.g. an XLA lowering bug classed INTERNAL) is
+                # caught by the consecutive-failure cap: past the limit the
+                # latch path below runs after all.
+                _packed_transient_count += 1
+                raise
             # packed compile/run failure must never fail the query: fall
-            # back to per-leaf fetch for the process lifetime
-            _packed_fetch_broken = True
+            # back to per-leaf fetch.  The process-lifetime latch commits
+            # only AFTER per-leaf succeeds below — per-leaf working while
+            # packed fails is the actual evidence against packing; if
+            # per-leaf fails too (whole backend down), the failure carried
+            # no packed-specific signal and must not latch.
+            latch_pending = True
             import logging
 
             logging.getLogger("bqueryd_tpu").exception(
-                "packed fetch unavailable on this backend; using per-leaf "
+                "packed fetch failed; retrying this query via per-leaf "
                 "device_get"
             )
         else:
+            _packed_transient_count = 0
             leaves = _unpack_host(flat, spec["leaves"])
             return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
     program, _spec = run(False)
-    return jax.device_get(program(codes_d, *measures_d))
+    result = jax.device_get(program(codes_d, *measures_d))
+    if latch_pending:
+        _packed_fetch_broken = True
+        _packed_transient_count = 0
+        import logging
+
+        logging.getLogger("bqueryd_tpu").warning(
+            "packed fetch unavailable on this backend (per-leaf fetch "
+            "succeeded where the packed program failed); using per-leaf "
+            "device_get for the process lifetime"
+        )
+    return result

@@ -489,3 +489,194 @@ def test_threaded_alignment_matches_sequential(sharded, mesh, monkeypatch):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         for col in s_vals:
             np.testing.assert_array_equal(s_vals[col], p_vals[col])
+
+
+def test_transient_runtime_error_retried_once(sharded, mesh, monkeypatch):
+    """One transient JaxRuntimeError out of the merged-program dispatch
+    (tunneled backends surface flaky remote-compile INTERNAL errors) must
+    be retried in place so the mesh path still answers; a second failure
+    propagates (the worker then degrades to the engine path)."""
+    import jax
+
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    df, tables = sharded
+    real = ex_mod._mesh_partials
+    calls = {"n": 0}
+
+    def flaky(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: remote_compile: HTTP 500"
+            )
+        return real(*args, **kw)
+
+    monkeypatch.setattr(ex_mod, "_mesh_partials", flaky)
+    got = mesh_result(
+        tables, ["passenger_count"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    assert calls["n"] == 2, "first failure must be retried exactly once"
+    expected = df.groupby("passenger_count")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["passenger_count"])
+
+    # persistent failure propagates after the single retry
+    calls["n"] = 0
+
+    def always_fail(*args, **kw):
+        calls["n"] += 1
+        raise jax.errors.JaxRuntimeError("INTERNAL: remote_compile: HTTP 500")
+
+    monkeypatch.setattr(ex_mod, "_mesh_partials", always_fail)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        mesh_result(
+            tables, ["VendorID"], [["fare_amount", "sum", "fare_amount"]]
+        )
+    assert calls["n"] == 2
+
+
+def test_internal_error_does_not_latch_packed_fetch_off(
+    sharded, mesh, monkeypatch
+):
+    """A transient INTERNAL JaxRuntimeError during the packed-fetch program
+    must NOT set the process-lifetime _packed_fetch_broken latch (that
+    would put every later query on per-leaf fetch — one transport
+    round-trip per result leaf on tunneled devices); only a deterministic
+    rejection (non-INTERNAL) is evidence against packing."""
+    import jax
+
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    df, tables = sharded
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "1")
+    monkeypatch.setattr(ex_mod, "_packed_fetch_broken", False)
+    monkeypatch.setattr(ex_mod, "_packed_transient_count", 0)
+    real_program = ex_mod._mesh_program
+    calls = {"n": 0}
+
+    def flaky_program(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: remote_compile: HTTP 500"
+            )
+        return real_program(*args, **kw)
+
+    monkeypatch.setattr(ex_mod, "_mesh_program", flaky_program)
+    got = mesh_result(
+        tables, ["passenger_count"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    assert not ex_mod._packed_fetch_broken, (
+        "transient INTERNAL error must not disable packed fetch for the "
+        "process"
+    )
+    expected = df.groupby("passenger_count")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["passenger_count"])
+
+    # a deterministic rejection DOES latch (and the query still answers
+    # via per-leaf fetch, not an engine degrade)
+    monkeypatch.setattr(ex_mod, "_packed_fetch_broken", False)
+    state = {"first": True}
+
+    def rejecting_program(*args, **kw):
+        # reject only the packed variant (pack flag is positional arg 6)
+        if args[6] and state["first"]:
+            state["first"] = False
+            raise jax.errors.JaxRuntimeError(
+                "INVALID_ARGUMENT: bitcast not supported"
+            )
+        return real_program(*args, **kw)
+
+    monkeypatch.setattr(ex_mod, "_mesh_program", rejecting_program)
+    got2 = mesh_result(
+        tables, ["VendorID"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    assert ex_mod._packed_fetch_broken, (
+        "deterministic packed-program rejection must latch per-leaf fetch"
+    )
+    expected2 = df.groupby("VendorID")["fare_amount"].sum().reset_index()
+    assert_frames_match(got2, expected2, ["VendorID"])
+
+
+def test_repeated_transient_failures_latch_past_cap(
+    sharded, mesh, monkeypatch
+):
+    """A deterministic failure that carries a transient status (an XLA
+    lowering bug classed INTERNAL) must not dodge the per-leaf latch
+    forever: past _PACKED_TRANSIENT_LIMIT consecutive packed failures the
+    latch sets anyway and the query answers via per-leaf fetch."""
+    import jax
+
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    df, tables = sharded
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "1")
+    monkeypatch.setattr(ex_mod, "_packed_fetch_broken", False)
+    monkeypatch.setattr(ex_mod, "_packed_transient_count", 0)
+    real_program = ex_mod._mesh_program
+
+    def always_internal_on_packed(*args, **kw):
+        if args[6]:  # the packed program variant
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: Mosaic lowering failed (deterministic)"
+            )
+        return real_program(*args, **kw)
+
+    monkeypatch.setattr(ex_mod, "_mesh_program", always_internal_on_packed)
+    # first query: both packed attempts raise transiently -> propagates
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        mesh_result(
+            tables, ["passenger_count"],
+            [["fare_amount", "sum", "fare_amount"]],
+        )
+    # second query: cap reached -> latch sets, per-leaf fetch answers
+    got = mesh_result(
+        tables, ["VendorID"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    assert ex_mod._packed_fetch_broken
+    expected = df.groupby("VendorID")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["VendorID"])
+
+
+def test_backend_outage_does_not_latch_packed_fetch(
+    sharded, mesh, monkeypatch
+):
+    """When packed AND per-leaf both fail (whole backend down), the failure
+    carries no packed-specific signal: the per-leaf latch must stay unset
+    so packing resumes once the backend recovers."""
+    import jax
+
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    df, tables = sharded
+    monkeypatch.setenv("BQUERYD_TPU_PACKED_FETCH", "1")
+    monkeypatch.setattr(ex_mod, "_packed_fetch_broken", False)
+    # at the cap: the next packed failure takes the latch-pending path
+    monkeypatch.setattr(
+        ex_mod, "_packed_transient_count", ex_mod._PACKED_TRANSIENT_LIMIT
+    )
+    real_program = ex_mod._mesh_program
+    down = {"is": True}
+
+    def outage_program(*args, **kw):
+        if down["is"]:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: tunnel down")
+        return real_program(*args, **kw)
+
+    monkeypatch.setattr(ex_mod, "_mesh_program", outage_program)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        mesh_result(
+            tables, ["passenger_count"],
+            [["fare_amount", "sum", "fare_amount"]],
+        )
+    assert not ex_mod._packed_fetch_broken, (
+        "an outage that also kills per-leaf fetch must not latch packing off"
+    )
+    # backend recovers: packed fetch resumes and the query answers
+    down["is"] = False
+    got = mesh_result(
+        tables, ["VendorID"], [["fare_amount", "sum", "fare_amount"]]
+    )
+    assert not ex_mod._packed_fetch_broken
+    expected = df.groupby("VendorID")["fare_amount"].sum().reset_index()
+    assert_frames_match(got, expected, ["VendorID"])
