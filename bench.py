@@ -475,7 +475,7 @@ def main():
         flag: os.environ.pop(flag, None)
         for flag in ("BQUERYD_TPU_PALLAS", "BQUERYD_TPU_FORCE_MATMUL")
     }
-    head_base_df = None
+    base_dfs = {}  # per-config baseline frames for the variant gates
     try:
         import jax
 
@@ -485,7 +485,7 @@ def main():
             # writes into ``out``, NOT ``results``: a watchdog-abandoned
             # thread that later completes must not mutate the dict the main
             # thread is iterating for emission
-            nonlocal floor_s, head_base_df
+            nonlocal floor_s
             files, gcols, aggs, where = config_query(config, names)
             nrows = ROWS * len(files) // SHARDS
             # warmup: storage decode, XLA compile, HBM/alignment caches.
@@ -538,8 +538,7 @@ def main():
                 )
                 base_walls.append(wall)
             base_wall = min(base_walls)
-            if config == HEADLINE:
-                head_base_df = base_df
+            base_dfs[config] = base_df
             check_result(result, base_df, gcols, aggs, config)
             worker_total = _phase_total(our_timings)
             out[config] = {
@@ -632,7 +631,13 @@ def main():
         variants = []
         if os.environ.get("BENCH_PALLAS", "1") == "1":
             if jax.default_backend() == "tpu":
-                variants.append(("pallas", "BQUERYD_TPU_PALLAS"))
+                variants.append((HEADLINE, "pallas", "BQUERYD_TPU_PALLAS"))
+                # the group-tiled hicard Pallas kernel vs the blocked
+                # scatter at 70k groups (route-decision data: the pre-fix
+                # hardware sample for the scatter was 0.583 s)
+                variants.append(
+                    ("highcard", "pallas", "BQUERYD_TPU_PALLAS")
+                )
             else:
                 # Pallas rides the matmul route, which auto-disables off-TPU:
                 # on a CPU backend the flag would silently re-measure the
@@ -647,11 +652,15 @@ def main():
             os.environ.get("BENCH_FORCED_MATMUL", "1") == "1"
             and jax.default_backend() == "cpu"
         ):
-            variants.append(("forced_matmul", "BQUERYD_TPU_FORCE_MATMUL"))
-        for vname, vflag in (
-            variants if not wedged and HEADLINE in completed else []
+            variants.append(
+                (HEADLINE, "forced_matmul", "BQUERYD_TPU_FORCE_MATMUL")
+            )
+        for vcfg, vname, vflag in (
+            variants if not wedged else []
         ):
-            files, gcols, aggs, where = config_query(HEADLINE, names)
+            if vcfg not in completed:
+                continue
+            files, gcols, aggs, where = config_query(vcfg, names)
             os.environ[vflag] = "1"
             try:
                 rpc.groupby(files, gcols, aggs, where)  # compile warmup
@@ -667,29 +676,30 @@ def main():
                     )
                 v_wall, v_timings = min(v_repeats, key=lambda r: r[0])
                 check_result(
-                    v_result, head_base_df, gcols, aggs,
-                    f"{HEADLINE}+{vname}",
+                    v_result, base_dfs[vcfg], gcols, aggs,
+                    f"{vcfg}+{vname}",
                 )
-                results[f"{HEADLINE}_{vname}"] = {
-                    "rows": ROWS,
-                    "groups": results[HEADLINE]["groups"],
+                v_rows = results[vcfg]["rows"]
+                results[f"{vcfg}_{vname}"] = {
+                    "rows": v_rows,
+                    "groups": results[vcfg]["groups"],
                     "framework_wall_s": round(v_wall, 4),
                     "cold_wall_s": None,
-                    "reference_shaped_wall_s": results[HEADLINE][
+                    "reference_shaped_wall_s": results[vcfg][
                         "reference_shaped_wall_s"
                     ],
-                    "rows_per_sec": round(ROWS / v_wall, 1),
+                    "rows_per_sec": round(v_rows / v_wall, 1),
                     "speedup": round(
-                        results[HEADLINE]["reference_shaped_wall_s"]
+                        results[vcfg]["reference_shaped_wall_s"]
                         / v_wall,
                         3,
                     ),
                     "phase_timings": v_timings,
                 }
                 print(
-                    f"[bench] {HEADLINE}+{vname}: {v_wall:.3f}s "
+                    f"[bench] {vcfg}+{vname}: {v_wall:.3f}s "
                     f"(default route was "
-                    f"{results[HEADLINE]['framework_wall_s']:.3f}s)",
+                    f"{results[vcfg]['framework_wall_s']:.3f}s)",
                     file=sys.stderr,
                     flush=True,
                 )

@@ -277,6 +277,51 @@ def _matmul_profitable(measures, ops, n, n_groups):
     return not measures  # rows-count-only query still benefits
 
 
+def _hicard_matmul_profitable(measures, ops, n, n_groups):
+    """Whether the group-tiled Pallas MXU path should take a query past
+    ``matmul_groups_limit``.  Opt-in (BQUERYD_TPU_PALLAS=1) until proven on
+    hardware; INT sums/counts only — the kernel's in-kernel mod-2^32 limb
+    accumulation has no wrap-free encoding for float Dekker limbs, and
+    min/max ride dedicated scatter kernels regardless.  The pre-fix
+    hardware sample for the 70k-group blocked scatter was 0.583 s at 10M
+    rows; the one-hot contraction is ~1.4e12 bf16 MACs there, tens of ms
+    at realistic MXU utilization."""
+    from bqueryd_tpu.ops import pallas_groupby
+
+    if not pallas_groupby.pallas_enabled():
+        return False
+    if (
+        jax.default_backend() == "cpu"
+        and os.environ.get("BQUERYD_TPU_FORCE_MATMUL") != "1"
+    ):
+        return False  # same CPU-emulation economics as _matmul_profitable
+    if not (
+        matmul_groups_limit()
+        < n_groups
+        <= pallas_groupby.hicard_groups_limit()
+    ):
+        return False
+    if n > pallas_groupby.HICARD_MAX_ROWS:
+        return False
+    if not measures:
+        return True  # rows-count-only query
+    for values, op in zip(measures, ops):
+        if op in ("count", "count_na"):
+            continue
+        if op not in ("sum", "mean"):
+            return False  # min/max scatter anyway: no matmul rows to win
+        dt = jnp.dtype(values.dtype)
+        if jnp.issubdtype(dt, jnp.floating):
+            return False
+    # the stacked row count must fit the kernel's VMEM plan: one count row,
+    # per-measure present rows (worst case), 8 limbs per 64-bit measure
+    est_rows = 1 + sum(
+        1 + (jnp.dtype(v.dtype).itemsize if v.dtype != jnp.bool_ else 1)
+        for v in measures
+    )
+    return pallas_groupby.hicard_fits_vmem(est_rows)
+
+
 def partial_tables(codes, measures, ops, n_groups, mask=None,
                    null_sentinels=None):
     """Compute per-group partial tables for one shard.
@@ -324,6 +369,14 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
             # group count where its smallest one-hot tile still fits
             use_pallas=pallas_groupby.pallas_enabled()
             and int(n_groups) <= pallas_groupby.pallas_groups_limit(),
+            null_sentinels=null_sentinels,
+        )
+    if _hicard_matmul_profitable(
+        measures, ops, int(codes.shape[0]), int(n_groups)
+    ):
+        return _partial_tables_mm(
+            codes, measures, ops, int(n_groups), mask,
+            use_pallas="hicard",
             null_sentinels=null_sentinels,
         )
     return _partial_tables_scatter(
@@ -493,7 +546,32 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif op in ("min", "max"):
             plans.append((op, op, values, present_row, null))
 
-    if use_pallas:
+    if use_pallas == "hicard":
+        from bqueryd_tpu.ops import pallas_groupby
+
+        # the dispatcher estimated the row count; the exact count is known
+        # here — past the VMEM plan the scatter path must take over (NOT
+        # the XLA dot below, whose [nb, K, G] one-hot materializes
+        # gigabytes at this cardinality)
+        if not (
+            pallas_groupby.hicard_fits_vmem(len(rows))
+            and not float_rows
+        ):
+            return _partial_tables_scatter(
+                codes, measures, ops, n_groups, mask,
+                null_sentinels=null_sentinels,
+            )
+        # group-tiled fused kernel: [R, G] uint32 limb totals mod 2^32,
+        # zero-extended so the downstream uint64 recombination is unchanged
+        # (the sum over the singleton block axis is a no-op)
+        out = pallas_groupby.onehot_rows_dot_hicard(
+            folded,
+            jnp.stack(rows, axis=0),
+            n_rows=len(rows),
+            n_groups=n_groups,
+            interpret=jax.default_backend() != "tpu",
+        )[None, : len(rows), :n_groups]
+    elif use_pallas:
         from bqueryd_tpu.ops import pallas_groupby
 
         # the dispatcher's gate only knew n_groups; the stacked row count is
@@ -502,7 +580,7 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         # python branch: len(rows) and n_groups are trace-time constants.
         if not pallas_groupby.fits_vmem(len(rows), n_groups):
             use_pallas = False
-    if use_pallas:
+    if use_pallas and use_pallas != "hicard":
         from bqueryd_tpu.ops import pallas_groupby
 
         # fused VMEM kernel: one-hot tiles formed on the fly, never in HBM
@@ -513,7 +591,7 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
             n_groups=n_groups,
             interpret=jax.default_backend() != "tpu",
         )[:, : len(rows), :n_groups]
-    else:
+    elif use_pallas != "hicard":
         lhs = jnp.stack(
             [_blocked(r, nb, pad) for r in rows], axis=1
         )  # [nb,R,K]

@@ -159,6 +159,152 @@ def _call(codes_flat, lhs, n_rows, n_groups, interpret):
     )(codes_flat, lhs)
 
 
+#: group-tile width of the high-cardinality kernel: one lane-multiple of
+#: output groups computed per outer grid step
+_HICARD_GT = 2048
+
+#: inner K tile of the high-cardinality kernel ([KT, GT] bf16 one-hot =
+#: 2 MB VMEM at the defaults)
+_HICARD_KT = 512
+
+#: uint32 accumulator bound: every 8-bit limb row's TOTAL sum must stay
+#: below 2^32 (limb values <= 255), so rows beyond this need the caller to
+#: split the call or take another path
+HICARD_MAX_ROWS = (1 << 32) // 256
+
+
+def hicard_groups_limit():
+    """Group-count ceiling of the high-cardinality kernel.  The one-hot
+    contraction costs ``rows * groups`` MXU MACs; past a few hundred
+    thousand groups the sort path wins back.  Tunable for hardware A/B
+    (BQUERYD_TPU_PALLAS_HICARD_GROUPS)."""
+    return int(
+        os.environ.get("BQUERYD_TPU_PALLAS_HICARD_GROUPS", 1 << 18)
+    )
+
+
+def hicard_fits_vmem(n_rows):
+    """Whether ``n_rows`` stacked reduction rows fit the high-cardinality
+    kernel's VMEM plan (its group tile is fixed, so only the row count
+    scales the working set: double-buffered lhs blocks dominate)."""
+    rpad = _round_up(max(n_rows, 1), _SUBLANE)
+    need = (
+        _HICARD_KT * _HICARD_GT * 2      # bf16 one-hot tile
+        + rpad * _HICARD_GT * 4 * 2      # i32 out block (+revisit headroom)
+        + 2 * rpad * BLOCK_K * 2         # double-buffered bf16 lhs block
+        + 2 * BLOCK_K * 4                # double-buffered i32 codes block
+    )
+    return need <= _VMEM_BUDGET_BYTES
+
+
+def _make_hicard_kernel(tile_k, gt):
+    def kernel(codes_ref, lhs_ref, out_ref):
+        # out block revisited across the inner (row-block) grid dim:
+        # zero once, accumulate each block's exact f32 partial in int32
+        @pl.when(pl.program_id(1) == 0)
+        def _zero():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        g0 = pl.program_id(0) * jnp.int32(gt)
+
+        def body(kt, carry):
+            off = kt * jnp.int32(tile_k)
+            c = codes_ref[pl.ds(off, tile_k)]  # [KT] i32
+            iota = g0 + lax.broadcasted_iota(jnp.int32, (tile_k, gt), 1)
+            one_hot = (c[:, None] == iota).astype(jnp.bfloat16)
+            lhs = lhs_ref[:, pl.ds(off, tile_k)]  # [R, KT] bf16
+            part = lax.dot_general(
+                lhs,
+                one_hot,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # the K-tile partial is < 2^24 (tile_k * limb max 255), exact
+            # in f32 and in the i32 convert; i32 accumulation wraps mod
+            # 2^32, which the caller's uint32 bitcast recombination
+            # absorbs (limb totals bounded by HICARD_MAX_ROWS * 255)
+            out_ref[...] += part.astype(jnp.int32)
+            return carry
+
+        lax.fori_loop(
+            jnp.int32(0), jnp.int32(BLOCK_K // tile_k), body, jnp.int32(0)
+        )
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "n_groups", "interpret")
+)
+def onehot_rows_dot_hicard(codes, rows, n_rows, n_groups, interpret=False):
+    """High-cardinality variant: ``out[r, g] = sum_k rows[r, k] *
+    (codes[k] == g)`` with the block reduction performed IN-KERNEL in
+    int32 (mod 2^32), so the output is ``[R, G]`` instead of the base
+    kernel's per-block ``[nb, R, G]`` — at 70k+ groups the per-block
+    partials would otherwise materialize gigabytes in HBM.
+
+    INT rows only (count flags and 8-bit limbs, values <= 255): the mod-2^32
+    accumulation is exact for them below ``HICARD_MAX_ROWS`` rows; float
+    Dekker limbs have no wrap-free encoding here and must stay off this path.
+
+    codes: int32[n] folded group codes (negative = contributes nowhere)
+    rows:  bf16[R, n] stacked int reduction rows
+    Returns uint32[R16, G128] limb totals mod 2^32 (R16/G128 rounded up to
+    tile multiples — callers slice ``[:R, :G]`` and zero-extend to uint64).
+    """
+    n = codes.shape[0]
+    if n > HICARD_MAX_ROWS:
+        raise ValueError(
+            f"n={n} exceeds HICARD_MAX_ROWS={HICARD_MAX_ROWS}: a limb "
+            "total could wrap twice; split the call or use the sort path"
+        )
+    if not hicard_fits_vmem(n_rows):
+        # the invariant lives here, not only in the dispatcher's boolean
+        # (same rule as onehot_rows_dot): past this row count the lhs
+        # double-buffer overflows VMEM and Mosaic's failure mode is an
+        # opaque exhaustion
+        raise ValueError(
+            f"n_rows={n_rows} exceeds the hicard kernel's VMEM budget; "
+            "use the scatter path"
+        )
+    npad = _round_up(max(n, 1), BLOCK_K)
+    rpad = _round_up(n_rows, _SUBLANE)
+    gpad = _round_up(n_groups, _HICARD_GT)
+    codes_p = jnp.pad(
+        codes.astype(jnp.int32), (0, npad - n), constant_values=-1
+    )
+    rows_p = jnp.pad(
+        rows.astype(jnp.bfloat16), ((0, rpad - n_rows), (0, npad - n))
+    )
+    nb = npad // BLOCK_K
+    ngt = gpad // _HICARD_GT
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _make_hicard_kernel(_HICARD_KT, _HICARD_GT),
+            out_shape=jax.ShapeDtypeStruct((rpad, gpad), jnp.int32),
+            # row-block dim innermost: the output block stays resident in
+            # VMEM while the whole row range accumulates into it
+            grid=(ngt, nb),
+            in_specs=[
+                pl.BlockSpec(
+                    (BLOCK_K,), lambda g, b: (b,), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (rpad, BLOCK_K),
+                    lambda g, b: (0, b),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (rpad, _HICARD_GT),
+                lambda g, b: (0, g),
+                memory_space=pltpu.VMEM,
+            ),
+            interpret=interpret,
+        )(codes_p, rows_p)
+    return lax.bitcast_convert_type(out, jnp.uint32)
+
+
 @functools.partial(
     jax.jit, static_argnames=("n_rows", "n_groups", "interpret")
 )

@@ -727,17 +727,36 @@ def packed_fetch_enabled():
     return os.environ.get("BQUERYD_TPU_PACKED_FETCH", "1") == "1"
 
 
+def _route_key():
+    """The env-derived knobs that steer the kernel route inside
+    ``ops.partial_tables`` AT TRACE TIME.  They must be part of the
+    ``_mesh_program`` cache key: the dispatcher reads them per call, but a
+    cached program never re-runs the dispatcher — without the key a
+    runtime flag flip (the bench's pallas variants, a live worker being
+    re-tuned) would silently keep serving the previously-traced route."""
+    from bqueryd_tpu.ops import groupby as gb
+    from bqueryd_tpu.ops import pallas_groupby as pg
+
+    return (
+        pg.pallas_enabled(),
+        os.environ.get("BQUERYD_TPU_FORCE_MATMUL") == "1",
+        gb.matmul_groups_limit(),
+        gb._matmul_cells_limit(),
+        pg.hicard_groups_limit(),
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
-                  null_sentinels=None):
+                  null_sentinels=None, route=None):
     """Build + cache the jitted shard_map program for one query shape.
 
     The key carries everything that can change the traced program — measure
     wire dtypes AND the per-device row width (``in_width``): the packed
     output's host-side unpack spec is captured at trace time, and both leaf
     dtypes (via the measure dtypes) and the kernel route (via the row count,
-    ``_matmul_cells_limit``) feed it, so one cache entry must map to exactly
-    one trace."""
+    ``_matmul_cells_limit``, and the ``route`` flag tuple) feed it, so one
+    cache entry must map to exactly one trace."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -824,6 +843,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             mesh, axis, tuple(agg_ops), int(n_groups), in_dtypes,
             int(codes_d.shape[1]), pack_flag,
             null_sentinels,  # part of the lru key: it changes the trace
+            route=_route_key(),  # ditto: the flags steer the traced route
         )
 
     global _packed_transient_count

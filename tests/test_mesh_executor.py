@@ -680,3 +680,32 @@ def test_backend_outage_does_not_latch_packed_fetch(
     assert not ex_mod._packed_fetch_broken
     expected = df.groupby("VendorID")["fare_amount"].sum().reset_index()
     assert_frames_match(got, expected, ["VendorID"])
+
+
+def test_route_flag_flip_rebuilds_mesh_program(sharded, mesh, monkeypatch):
+    """The kernel route is decided at TRACE time inside the cached mesh
+    program: flipping a route flag (the bench's pallas variants, live
+    re-tuning) must be a cache MISS that re-traces, not a silent hit that
+    keeps serving the old route (the r4 bench's sharded_pallas number was
+    exactly that sham, on the CPU side)."""
+    from bqueryd_tpu.parallel import executor as ex_mod
+
+    df, tables = sharded
+    monkeypatch.delenv("BQUERYD_TPU_PALLAS", raising=False)
+    args = (["passenger_count"], [["passenger_count", "sum", "s"]])
+    mesh_result(tables, *args)
+    before = ex_mod._mesh_program.cache_info()
+    # same query, same flags: cache hit
+    mesh_result(tables, *args)
+    mid = ex_mod._mesh_program.cache_info()
+    assert mid.misses == before.misses, "same-flags repeat must not re-trace"
+    # flipped flag: cache miss (fresh trace through the dispatcher)
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    got = mesh_result(tables, *args)
+    after = ex_mod._mesh_program.cache_info()
+    assert after.misses > mid.misses, "flag flip must rebuild the program"
+    got = got.sort_values("passenger_count").reset_index(drop=True)
+    truth = df.groupby("passenger_count")["passenger_count"].sum()
+    np.testing.assert_array_equal(
+        got["s"].to_numpy(), truth.sort_index().to_numpy()
+    )

@@ -1057,6 +1057,89 @@ def test_worker_degrades_mesh_runtime_error_to_engine(tmp_path, caplog):
     np.testing.assert_array_equal(got["s"].to_numpy(), exp["s"].to_numpy())
 
 
+def test_hicard_pallas_path_bit_exact(monkeypatch):
+    """The group-tiled Pallas MXU path (BQUERYD_TPU_PALLAS=1 past
+    matmul_groups_limit) must agree bit-for-bit with numpy: int64 sums
+    with negatives, unsigned means, null codes, and ragged padding in
+    both the row-block and group-tile dimensions (40k rows -> 2 blocks;
+    9k groups -> 5 group tiles of 2048)."""
+    import jax
+
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    g = _groupby_module()
+    rng = np.random.default_rng(1)
+    n, ng = 40_000, 9_000
+    codes = rng.integers(-1, ng, n).astype(np.int64)
+    v64 = rng.integers(-(2**40), 2**40, n).astype(np.int64)
+    vu8 = rng.integers(0, 250, n).astype(np.uint8)
+    assert g._hicard_matmul_profitable((v64, vu8), ("sum", "mean"), n, ng)
+    out = jax.device_get(
+        g.partial_tables(
+            np.asarray(codes), (v64, vu8), ("sum", "mean"), n_groups=ng
+        )
+    )
+    valid = codes >= 0
+    truth_s = np.zeros(ng, dtype=np.int64)
+    np.add.at(truth_s, codes[valid], v64[valid])
+    got_s = np.asarray(out["aggs"][0]["sum"])
+    assert got_s.dtype == np.int64
+    np.testing.assert_array_equal(got_s, truth_s)
+    truth_u = np.zeros(ng, dtype=np.uint64)
+    np.add.at(truth_u, codes[valid], vu8[valid].astype(np.uint64))
+    cnt = np.bincount(codes[valid], minlength=ng)
+    np.testing.assert_array_equal(
+        np.asarray(out["aggs"][1]["sum"]).astype(np.uint64), truth_u
+    )
+    np.testing.assert_array_equal(np.asarray(out["aggs"][1]["count"]), cnt)
+    np.testing.assert_array_equal(np.asarray(out["rows"]), cnt)
+
+
+def test_hicard_gate_declines_incompatible_queries(monkeypatch):
+    """Floats (no wrap-free limb encoding), min/max (scatter anyway),
+    out-of-range cardinalities, and the default flag state must all stay
+    off the high-cardinality Pallas path."""
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    g = _groupby_module()
+    n, ng = 40_000, 9_000
+    i64 = np.ones(n, dtype=np.int64)
+    f32 = np.ones(n, dtype=np.float32)
+    assert g._hicard_matmul_profitable((i64,), ("sum",), n, ng)
+    assert not g._hicard_matmul_profitable((f32,), ("sum",), n, ng)
+    assert not g._hicard_matmul_profitable((i64,), ("min",), n, ng)
+    # inside matmul_groups_limit the classic path owns it
+    assert not g._hicard_matmul_profitable((i64,), ("sum",), n, 100)
+    # past the hicard ceiling the sort/scatter path owns it
+    from bqueryd_tpu.ops import pallas_groupby as pg
+
+    over = pg.hicard_groups_limit() + 1
+    assert not g._hicard_matmul_profitable((i64,), ("sum",), n, over)
+    # default flag state: off
+    monkeypatch.delenv("BQUERYD_TPU_PALLAS")
+    assert not g._hicard_matmul_profitable((i64,), ("sum",), n, ng)
+
+
+def test_hicard_kernel_rejects_wrap_risk():
+    """Past HICARD_MAX_ROWS a limb total could wrap uint32 twice; the
+    kernel must refuse (and the dispatcher gate declines the same bound)."""
+    import jax.numpy as jnp
+
+    from bqueryd_tpu.ops import pallas_groupby as pg
+
+    g = _groupby_module()
+    fake_n = pg.HICARD_MAX_ROWS + 1
+    assert not g._hicard_matmul_profitable(
+        (np.ones(8, dtype=np.int64),), ("sum",), fake_n, 9_000
+    )
+    with pytest.raises(ValueError, match="HICARD_MAX_ROWS"):
+        pg.onehot_rows_dot_hicard(
+            jnp.zeros(fake_n, jnp.int32),
+            jnp.zeros((1, fake_n), jnp.bfloat16),
+            n_rows=1,
+            n_groups=9_000,
+            interpret=True,
+        )
+
+
 def test_count_distinct_refuses_composite_overflow():
     from bqueryd_tpu import ops
 

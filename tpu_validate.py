@@ -212,6 +212,65 @@ def main():
         )
         checkpoint()
 
+        # the group-tiled Pallas MXU path at the same highcard shape: the
+        # candidate replacement for the 0.583 s blocked scatter (route
+        # decision data; gated off by default until this number exists)
+        name = "sum_i64_10M_70225g_hicard_pallas"
+        if jax.default_backend() != "tpu":
+            report["kernel_bench"][name] = {"skipped": "needs a tpu backend"}
+        else:
+            try:
+                import jax.numpy as jnp
+
+                n, g = 10_000_000, 70_225
+                codes = rng.integers(0, g, n).astype(np.int64)
+                vals = rng.integers(-1000, 1000, n).astype(np.int64)
+                os.environ["BQUERYD_TPU_PALLAS"] = "1"
+                try:
+                    codes_d = jax.device_put(codes)
+                    vals_d = jax.device_put(vals)
+                    jax.block_until_ready((codes_d, vals_d))
+                    assert gb._hicard_matmul_profitable(
+                        (vals_d,), ("sum",), n, g
+                    ), "hicard gate did not fire"
+                    t_first = time.perf_counter()
+                    r = gb.partial_tables(codes_d, (vals_d,), ("sum",), g)
+                    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+                    first_s = time.perf_counter() - t_first
+                    walls = []
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        r = gb.partial_tables(
+                            codes_d, (vals_d,), ("sum",), g
+                        )
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(r)
+                        )
+                        walls.append(time.perf_counter() - t0)
+                finally:
+                    os.environ.pop("BQUERYD_TPU_PALLAS", None)
+                truth = np.zeros(g, dtype=np.int64)
+                np.add.at(truth, codes, vals)
+                report["kernel_bench"][name] = {
+                    "wall_s": round(min(walls), 5),
+                    "rows_per_sec": round(n / min(walls), 1),
+                    "compile_plus_first_s": round(first_s, 2),
+                    "exact": bool(
+                        (np.asarray(r["aggs"][0]["sum"]) == truth).all()
+                    ),
+                }
+            except Exception:
+                report["kernel_bench"][name] = {
+                    "error": traceback.format_exc(limit=2)
+                }
+            print(
+                f"[tpu_validate] kernel {name}: "
+                f"{report['kernel_bench'][name]}",
+                file=sys.stderr,
+                flush=True,
+            )
+            checkpoint()
+
         # one MESH-program data point: the exact serving program (shard_map
         # + psum merge + packed single-buffer fetch) on this backend's
         # devices — distinct from the bare kernel above, which skips the
@@ -334,7 +393,21 @@ def main():
     budget_s = float(os.environ.get("TPU_VALIDATE_BUDGET_S", 2400))
     over_budget = False
     t_fuzz = time.time()  # the budget bounds the fuzz loop only
+    # a case whose program wedges the tunnel compile-helper blocks the loop
+    # from INSIDE a native call (no signal can interrupt it; the round-5
+    # window wedged at case20 that way, killing cases 21-26).  The skip
+    # list lets a re-run route around a known-wedging case and still bank
+    # the rest: TPU_VALIDATE_SKIP_CASES="20,23"
+    skip_cases = {
+        int(c)
+        for c in os.environ.get("TPU_VALIDATE_SKIP_CASES", "").split(",")
+        if c.strip()
+    }
     for case_i, (gcols, agg_list, where) in enumerate(fz.CASES):
+        if case_i in skip_cases:
+            report["cases"][f"case{case_i}:engine"] = {"status": "skipped"}
+            report["cases"][f"case{case_i}:mesh"] = {"status": "skipped"}
+            continue
         if time.time() - t_fuzz > budget_s:
             over_budget = True
             break
@@ -409,8 +482,11 @@ def main():
         for v in report["kernel_bench"].values()
         if "error" in v or v.get("exact") is False
     )
-    report["complete"] = not over_budget
-    report["ok"] = failures == 0 and not over_budget
+    # operator-skipped cases are partial validation, same as a budget
+    # truncation: the one-line gate must not read as a full pass
+    report["cases_skipped"] = len(skip_cases)
+    report["complete"] = not over_budget and not skip_cases
+    report["ok"] = failures == 0 and report["complete"]
     report["failures"] = failures
     report["total_s"] = round(time.time() - t0, 1)
     checkpoint()
@@ -418,7 +494,9 @@ def main():
         json.dumps(
             {
                 k: report[k]
-                for k in ("backend", "ok", "complete", "failures")
+                for k in (
+                    "backend", "ok", "complete", "failures", "cases_skipped"
+                )
             }
         )
     )
