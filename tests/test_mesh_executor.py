@@ -709,3 +709,46 @@ def test_route_flag_flip_rebuilds_mesh_program(sharded, mesh, monkeypatch):
     np.testing.assert_array_equal(
         got["s"].to_numpy(), truth.sort_index().to_numpy()
     )
+
+
+def test_hicard_pallas_route_through_mesh(tmp_path, monkeypatch):
+    """The group-tiled hicard Pallas kernel inside the full mesh program
+    (shard_map + psum + packed fetch) — the exact composition the TPU
+    bench's highcard+pallas variant executes — must stay bit-exact."""
+    monkeypatch.setenv("BQUERYD_TPU_PALLAS", "1")
+    rng = np.random.default_rng(31)
+    n, ng = 30_000, 14_000  # observed uniques safely past matmul_groups_limit
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, ng, n).astype(np.int64),
+            "v": rng.integers(-(2**40), 2**40, n).astype(np.int64),
+        }
+    )
+    tables = []
+    for i in range(3):
+        root = str(tmp_path / f"hc{i}.bcolzs")
+        ctable.fromdataframe(df.iloc[i::3].reset_index(drop=True), root)
+        tables.append(ctable(root, mode="r"))
+    from bqueryd_tpu.ops import groupby as gb
+
+    # the executor routes on OBSERVED combos, not the fixture's nominal
+    # cardinality: guard with the value the gate actually sees, so a
+    # fixture drift below matmul_groups_limit cannot silently demote the
+    # test to the non-Pallas route
+    observed = df["k"].nunique()
+    assert observed > gb.matmul_groups_limit(), (
+        f"fixture drifted: {observed} observed groups no longer clears "
+        f"matmul_groups_limit ({gb.matmul_groups_limit()})"
+    )
+    assert gb._hicard_matmul_profitable(
+        (df["v"].to_numpy(),), ("sum",), n, observed
+    ), "fixture must hit the hicard gate"
+    got = mesh_result(tables, ["k"], [["v", "sum", "s"]])
+    got = got.sort_values("k").reset_index(drop=True)
+    exp = (
+        df.groupby("k", as_index=False)["v"].sum()
+        .rename(columns={"v": "s"})
+        .sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["k"].to_numpy(), exp["k"].to_numpy())
+    np.testing.assert_array_equal(got["s"].to_numpy(), exp["s"].to_numpy())
