@@ -30,6 +30,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from bqueryd_tpu.utils import devicehealth
+
 PAYLOAD_FORMAT = "bqueryd-tpu-result-1"
 
 #: the bquery aggregation surface (reference bquery API; reference tests
@@ -238,21 +240,37 @@ def device_dispatch_floor(remeasure=False):
     worker's background warmup compile) is inflated; the warmup thread
     calls ``remeasure=True`` when it finishes to replace any such sample."""
     global _measured_floor
+    if devicehealth.backend_wedged():
+        # do NOT cache: a recovered backend must remeasure a real floor
+        return devicehealth.probe_timeout_s()
     if _measured_floor is None or remeasure:
         import time
 
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
+        def _measure():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
 
-        f = jax.jit(lambda x: x + 1)
-        np.asarray(f(jnp.zeros(())))
-        walls = []
-        for _ in range(3):
-            t0 = time.perf_counter()
+            f = jax.jit(lambda x: x + 1)
             np.asarray(f(jnp.zeros(())))
-            walls.append(time.perf_counter() - t0)
-        _measured_floor = min(walls)
+            walls = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(f(jnp.zeros(())))
+                walls.append(time.perf_counter() - t0)
+            return min(walls)
+
+        # the measurement IS a device dispatch: on a wedged backend it
+        # would hang the calling thread (historically the worker loop, via
+        # the first query's routing) forever.  Run it sacrificially; a
+        # deadline miss latches the backend and host routing takes over.
+        done, floor = devicehealth.run_with_deadline(
+            _measure, devicehealth.probe_timeout_s()
+        )
+        if not done or floor is None:
+            devicehealth.latch_wedged()
+            return devicehealth.probe_timeout_s()
+        _measured_floor = floor
     return _measured_floor
 
 
@@ -360,6 +378,14 @@ def host_kernel_rows(ns_per_row=None):
     pass a per-query cost estimate (:func:`_host_ns_estimate`); default is
     the fast-path rate.  Override with BQUERYD_TPU_HOST_KERNEL_ROWS
     (0 disables host routing)."""
+    if devicehealth.backend_wedged(launch=False):
+        # wedged backend: EVERY query the host kernels can serve must go
+        # host — the alternative is a worker loop hung inside native code.
+        # Deliberately overrides the env pin (an operator's device-only
+        # setting is about performance; a wedge is about survival) and the
+        # 4M-row cap (the cap encodes host-vs-device economics that do not
+        # exist while the device cannot answer at all).
+        return 1 << 62
     env = os.environ.get("BQUERYD_TPU_HOST_KERNEL_ROWS")
     if env is not None:
         try:
@@ -672,20 +698,38 @@ class QueryEngine:
                     agg_parts[i] = dict(part)
             else:
                 # rows still needed to drop empty groups
-                rows = np.asarray(
-                    ops.partial_tables(
-                        dense.astype(np.int32),
-                        (np.zeros(len(dense)),),
-                        ("count",),
-                        ops.program_bucket(n_groups),
-                        mask_arr,
-                    )["rows"]
-                )[:n_groups]
+                if devicehealth.backend_wedged():
+                    # host bincount with partial_tables' exact semantics
+                    # (negative codes dropped, mask applied)
+                    d = (
+                        dense
+                        if mask_arr is None
+                        else np.where(mask_arr, dense, -1)
+                    )
+                    rows = np.bincount(
+                        d[d >= 0].astype(np.int64), minlength=n_groups
+                    )[:n_groups]
+                else:
+                    rows = np.asarray(
+                        ops.partial_tables(
+                            dense.astype(np.int32),
+                            (np.zeros(len(dense)),),
+                            ("count",),
+                            ops.program_bucket(n_groups),
+                            mask_arr,
+                        )["rows"]
+                    )[:n_groups]
             for i, agg in distinct:
                 in_col, op, _out = agg
                 vals = table.column_raw(in_col)
                 counts = None
-                if op == "count_distinct" and query.sole_payload:
+                if (
+                    op == "count_distinct"
+                    and query.sole_payload
+                    # wedged backend: fall through to the host set-shipping
+                    # branch below instead of hanging on the device sort
+                    and not devicehealth.backend_wedged()
+                ):
                     # single-shard query: this payload IS the final result,
                     # so the device sort kernel's per-group counts suffice
                     # (a device radix sort beats host np.unique at scale)
@@ -743,6 +787,15 @@ class QueryEngine:
                         "distinct_offsets": offsets,
                     }
                 elif op == "sorted_count_distinct":
+                    if devicehealth.backend_wedged():
+                        # no host twin for the run-leader kernel: fail fast
+                        # with a clear error instead of hanging the worker
+                        # loop on the dead backend (the client sees the
+                        # error reply; retry succeeds once recovered)
+                        raise RuntimeError(
+                            "sorted_count_distinct needs the device sort "
+                            "kernel but the accelerator backend is wedged"
+                        )
                     # run-boundary counts are inherently per-shard (the sort
                     # order is local); cross-shard merge stays additive
                     counts = ops.groupby_sorted_count_distinct(

@@ -1123,6 +1123,21 @@ def expand_mask_by_group(group_codes, mask, n_groups=None):
     safe-but-wasteful row count."""
     if mask is None:
         return None
+    from bqueryd_tpu.utils import devicehealth
+
+    if devicehealth.backend_wedged():
+        # host equivalent (same semantics: any selected row selects its
+        # whole group; negative codes never selected) — a wedged backend
+        # must not hang the basket filter
+        codes_np = np.asarray(group_codes)
+        mask_np = np.asarray(mask, dtype=bool)
+        if n_groups is None:
+            n_groups = codes_np.shape[0]
+        valid = codes_np >= 0
+        hit = np.zeros(max(int(n_groups), 1), dtype=bool)
+        sel = valid & mask_np
+        hit[codes_np[sel]] = True
+        return valid & hit[np.where(valid, codes_np, 0)]
     group_codes = jnp.asarray(group_codes)
     if n_groups is None:
         n_groups = group_codes.shape[0]

@@ -60,10 +60,23 @@ def translate_value(table, column, value, op="=="):
 
 
 def term_mask(values, op, value):
-    """Boolean mask for one term over a physical value array (jnp or np)."""
-    import jax.numpy as jnp
+    """Boolean mask for one term over a physical value array (jnp or np).
 
-    values = jnp.asarray(values)
+    On a wedged accelerator backend the mask computes in NumPy instead —
+    identical elementwise semantics, and the filter path must not be the
+    one device dispatch that hangs an otherwise host-served query.  (The
+    executor's device-resident columns never reach here while wedged: the
+    worker skips the mesh path entirely then.)"""
+    from bqueryd_tpu.utils import devicehealth
+
+    if devicehealth.backend_wedged():
+        import numpy as xp
+
+        values = xp.asarray(values)
+    else:
+        import jax.numpy as xp
+
+        values = xp.asarray(values)
     if op == "==":
         return values == value
     if op == "!=":
@@ -77,9 +90,9 @@ def term_mask(values, op, value):
     if op == ">=":
         return values >= value
     if op == "in":
-        return jnp.isin(values, jnp.asarray(value))
+        return xp.isin(values, xp.asarray(value))
     if op == "not in":
-        return ~jnp.isin(values, jnp.asarray(value))
+        return ~xp.isin(values, xp.asarray(value))
     raise ValueError(f"unsupported where op {op!r}")
 
 

@@ -590,11 +590,18 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
+        from bqueryd_tpu.utils import devicehealth
+
         total_rows = sum(int(t.nrows) for t in tables)
         # the same per-query cost estimate execute_local uses, worst shard
         # wins — a mismatched (optimistic) rate here would let slow-rated
-        # queries skip the mesh executor only to device-dispatch per shard
-        if MeshQueryExecutor.supports(query) and total_rows > host_kernel_rows(
+        # queries skip the mesh executor only to device-dispatch per shard.
+        # A wedged accelerator backend skips the mesh outright: the engine
+        # path below host-routes everything (host_kernel_rows returns its
+        # wedged sentinel) instead of hanging on a device dispatch.
+        if not devicehealth.backend_wedged() and MeshQueryExecutor.supports(
+            query
+        ) and total_rows > host_kernel_rows(
             max(
                 (
                     _host_ns_estimate(t, query.agg_list, total_rows)
