@@ -35,6 +35,8 @@ import traceback
 
 import zmq
 
+from bqueryd_tpu.utils import devicehealth
+
 import bqueryd_tpu
 from bqueryd_tpu import messages
 from bqueryd_tpu.coordination import coordination_store
@@ -288,6 +290,17 @@ class WorkerBase:
                 "pid": os.getpid(),
                 "uptime": time.time() - self.start_time,
                 "msg_count": self.msg_count,
+                # degraded-mode visibility: operators watching rpc.info()
+                # see a wedged accelerator the moment routing does.  CALC
+                # workers own the device, so their heartbeat ticks the
+                # probe clock too — an IDLE wedged worker still recovers
+                # (and stops advertising wedged) without waiting for a
+                # query.  Downloader/move roles never touch the device;
+                # their WRMs read passively so they never spawn jax
+                # probe threads as a side effect
+                "backend_wedged": devicehealth.backend_wedged(
+                    launch=self.workertype == "calc"
+                ),
             }
         )
 
@@ -589,8 +602,6 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu import ops as ops_mod
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
-
-        from bqueryd_tpu.utils import devicehealth
 
         total_rows = sum(int(t.nrows) for t in tables)
         # the same per-query cost estimate execute_local uses, worst shard

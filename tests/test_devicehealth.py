@@ -251,3 +251,22 @@ def test_wedged_worker_routes_around_mesh(monkeypatch, tmp_path):
     np.testing.assert_array_equal(
         got["s"].to_numpy(), exp.sort_index().to_numpy()
     )
+
+
+def test_prepare_wrm_carries_backend_wedged():
+    """The worker's register/heartbeat message surfaces the latch so
+    rpc.info() gives operators degraded-mode visibility."""
+    from bqueryd_tpu.worker import WorkerNode
+
+    worker = WorkerNode.__new__(WorkerNode)
+    worker.worker_id = "w1"
+    worker.node_name = "n1"
+    worker.data_dir = "/tmp"
+    worker.data_files = []
+    worker.workertype = "calc"
+    worker.start_time = time.time()
+    worker.msg_count = 0
+    devicehealth.force_state(False)
+    assert worker.prepare_wrm()["backend_wedged"] is False
+    devicehealth.force_state(True)
+    assert worker.prepare_wrm()["backend_wedged"] is True
