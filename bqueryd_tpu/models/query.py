@@ -787,21 +787,20 @@ class QueryEngine:
                         "distinct_offsets": offsets,
                     }
                 elif op == "sorted_count_distinct":
-                    if devicehealth.backend_wedged():
-                        # no host twin for the run-leader kernel: fail fast
-                        # with a clear error instead of hanging the worker
-                        # loop on the dead backend (the client sees the
-                        # error reply; retry succeeds once recovered)
-                        raise RuntimeError(
-                            "sorted_count_distinct needs the device sort "
-                            "kernel but the accelerator backend is wedged"
-                        )
                     # run-boundary counts are inherently per-shard (the sort
                     # order is local); cross-shard merge stays additive
-                    counts = ops.groupby_sorted_count_distinct(
-                        dense.astype(np.int32), vals,
-                        ops.program_bucket(n_groups), mask_arr,
-                    )
+                    if devicehealth.backend_wedged():
+                        # numpy twin with identical run-leader semantics:
+                        # the last device-only op also survives a wedge
+                        counts = ops.host_sorted_count_distinct(
+                            dense.astype(np.int32), vals,
+                            n_groups, mask_arr,
+                        )
+                    else:
+                        counts = ops.groupby_sorted_count_distinct(
+                            dense.astype(np.int32), vals,
+                            ops.program_bucket(n_groups), mask_arr,
+                        )
                     agg_parts[i] = {
                         "distinct": np.asarray(counts)[:n_groups]
                     }

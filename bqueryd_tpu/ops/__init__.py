@@ -129,6 +129,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     groupby_count_distinct,
     groupby_sorted_count_distinct,
     host_partial_tables,
+    host_sorted_count_distinct,
     partial_tables,
     program_bucket,
     psum_partials,
@@ -156,6 +157,7 @@ __all__ = [
     "groupby_sorted_count_distinct",
     "expand_mask_by_group",
     "host_partial_tables",
+    "host_sorted_count_distinct",
     "partial_tables",
     "program_bucket",
     "combine_partials",

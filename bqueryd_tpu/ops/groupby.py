@@ -1159,6 +1159,36 @@ def _expand_mask_jit(group_codes, mask, n_groups):
     return (hit[safe] > 0) & valid
 
 
+def host_sorted_count_distinct(codes, values, n_groups, mask=None):
+    """NumPy twin of :func:`groupby_sorted_count_distinct` (identical
+    run-boundary semantics, including masked-row bridging and NaN != NaN
+    starting a new run) — serves the op while the accelerator backend is
+    wedged (:mod:`bqueryd_tpu.utils.devicehealth`)."""
+    codes = np.asarray(codes)
+    values = np.asarray(values)
+    if codes.shape[0] == 0:
+        return np.zeros(int(n_groups), dtype=np.int64)
+    valid = codes >= 0
+    if mask is not None:
+        valid = valid & np.asarray(mask, dtype=bool)
+    idx = np.arange(codes.shape[0])
+    marked = np.where(valid, idx, -1)
+    last_valid = np.maximum.accumulate(marked)
+    prev_idx = np.concatenate([[-1], last_valid[:-1]])
+    has_prev = prev_idx >= 0
+    gather = np.clip(prev_idx, 0, None)
+    with np.errstate(invalid="ignore"):
+        same = (
+            has_prev
+            & (codes[gather] == codes)
+            & (values[gather] == values)
+        )
+    is_new_run = valid & ~same
+    out = np.zeros(max(int(n_groups), 1), dtype=np.int64)
+    np.add.at(out, codes[is_new_run].astype(np.int64), 1)
+    return out[: int(n_groups)]
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups",))
 def groupby_sorted_count_distinct(codes, values, n_groups, mask=None):
     """bquery's ``sorted_count_distinct``: counts value *runs* per group,

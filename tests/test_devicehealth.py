@@ -168,16 +168,27 @@ def test_wedged_engine_serves_exact_results(monkeypatch, tmp_path):
     exp = sel.groupby("k", as_index=False)["v"].sum()
     np.testing.assert_array_equal(got["s"].to_numpy(), exp["v"].to_numpy())
 
-    # device-only op: fail fast with a clear error, never hang
-    with pytest.raises(RuntimeError, match="wedged"):
-        run(
-            GroupByQuery(
-                ["k"],
-                [["basket", "sorted_count_distinct", "d"]],
-                [],
-                aggregate=True,
-            )
+    # formerly the one device-only op: the numpy run-leader twin serves it
+    got = run(
+        GroupByQuery(
+            ["k"],
+            [["basket", "sorted_count_distinct", "d"]],
+            [],
+            aggregate=True,
         )
+    )
+    b = df["basket"].to_numpy()
+    k = df["k"].to_numpy()
+    # run-leader ground truth: a row starts a run unless the ADJACENT
+    # previous row has the same (group, value) — the kernel's semantics
+    prev_same = np.concatenate(
+        [[False], (b[1:] == b[:-1]) & (k[1:] == k[:-1])]
+    )
+    exp = (
+        pd.DataFrame({"k": k, "new": ~prev_same})
+        .groupby("k")["new"].sum().sort_index()
+    )
+    np.testing.assert_array_equal(got["d"].to_numpy(), exp.to_numpy())
 
     got = run(
         GroupByQuery(

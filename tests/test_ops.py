@@ -1150,3 +1150,31 @@ def test_count_distinct_refuses_composite_overflow():
             2**32,
             2**32,
         )
+
+
+def test_host_sorted_count_distinct_matches_device():
+    """The numpy run-leader twin must agree with the device kernel on
+    adversarial layouts: masked rows bridging runs, null group codes,
+    NaN values (NaN != NaN starts a new run), and empty input."""
+    from bqueryd_tpu import ops
+
+    rng = np.random.default_rng(17)
+    n, g = 5_000, 37
+    codes = rng.integers(-1, g, n).astype(np.int32)
+    # sorted-ish values with repeats so real runs exist
+    values = np.sort(rng.integers(0, 50, n)).astype(np.float64)
+    values[rng.random(n) < 0.02] = np.nan
+    mask = rng.random(n) < 0.8
+    for m in (None, mask):
+        dev = np.asarray(
+            ops.groupby_sorted_count_distinct(codes, values, g, m)
+        )
+        host = ops.host_sorted_count_distinct(codes, values, g, m)
+        np.testing.assert_array_equal(host, dev)
+    # empty input
+    np.testing.assert_array_equal(
+        ops.host_sorted_count_distinct(
+            np.empty(0, np.int32), np.empty(0), 5
+        ),
+        np.zeros(5, np.int64),
+    )
