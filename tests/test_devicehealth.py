@@ -281,3 +281,76 @@ def test_prepare_wrm_carries_backend_wedged():
     assert worker.prepare_wrm()["backend_wedged"] is False
     devicehealth.force_state(True)
     assert worker.prepare_wrm()["backend_wedged"] is True
+
+
+def test_wedged_cluster_serves_via_rpc(tmp_path):
+    """Full-stack degraded mode: a live (threads-as-nodes) cluster with the
+    backend latched answers an RPC groupby exactly, and rpc.info() shows
+    the worker advertising backend_wedged."""
+    import logging
+    import os
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+    from tests.conftest import wait_until
+
+    rng = np.random.default_rng(9)
+    n = 40_000
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 9, n).astype(np.int64),
+            "v": rng.integers(-(2**40), 2**40, n).astype(np.int64),
+        }
+    )
+    ctable.fromdataframe(df, str(tmp_path / "t.bcolzs"))
+    url = f"mem://wedge-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url, loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path), heartbeat_interval=0.2,
+    )
+    worker = WorkerNode(
+        coordination_url=url, data_dir=str(tmp_path),
+        loglevel=logging.WARNING, restart_check=False,
+        heartbeat_interval=0.2, poll_timeout=0.1,
+    )
+    threads = [
+        threading.Thread(target=controller.go, daemon=True),
+        threading.Thread(target=worker.go, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        devicehealth.force_state(True)
+        wait_until(lambda: controller.worker_map, desc="worker registration")
+        rpc = RPC(coordination_url=url, timeout=30,
+                  loglevel=logging.WARNING)
+        wait_until(
+            lambda: any(
+                w.get("data_files")
+                for w in rpc.info().get("workers", {}).values()
+            ),
+            desc="worker registered",
+        )
+        got = rpc.groupby(
+            ["t.bcolzs"], ["k"], [["v", "sum", "s"]], []
+        ).sort_values("k").reset_index(drop=True)
+        exp = df.groupby("k")["v"].sum()
+        np.testing.assert_array_equal(
+            got["s"].to_numpy(), exp.sort_index().to_numpy()
+        )
+        # heartbeats advertise the latch within an interval
+        wait_until(
+            lambda: any(
+                w.get("backend_wedged")
+                for w in rpc.info().get("workers", {}).values()
+            ),
+            desc="wedged flag visible in info()",
+        )
+    finally:
+        devicehealth.force_state(False)
+        worker.stop()
+        controller.stop()
+        for t in threads:
+            t.join(timeout=10)
