@@ -486,6 +486,9 @@ def main():
             # thread that later completes must not mutate the dict the main
             # thread is iterating for emission
             nonlocal floor_s
+            from bqueryd_tpu.utils import devicehealth
+
+            wedge_start = devicehealth.wedge_marker()
             files, gcols, aggs, where = config_query(config, names)
             nrows = ROWS * len(files) // SHARDS
             # warmup: storage decode, XLA compile, HBM/alignment caches.
@@ -558,6 +561,11 @@ def main():
                 # against min-repeat walls made the data self-contradictory)
                 "phase_timings": our_timings,
                 "cold_phase_timings": cold_timings,
+                # evidence integrity: if a wedge OVERLAPPED this config's
+                # window (even one that recovered before this line), the
+                # devicehealth latch may have served HOST kernels — a wall
+                # recorded with this flag true is not a device number
+                "backend_wedged": devicehealth.window_dirty(wedge_start),
                 # client wall minus worker phase total = zmq + controller +
                 # pickle overhead; compare with device_roundtrip_floor_s
                 "worker_phase_total_s": worker_total,
@@ -660,6 +668,9 @@ def main():
         ):
             if vcfg not in completed:
                 continue
+            from bqueryd_tpu.utils import devicehealth
+
+            v_wedge_start = devicehealth.wedge_marker()
             files, gcols, aggs, where = config_query(vcfg, names)
             os.environ[vflag] = "1"
             try:
@@ -695,6 +706,9 @@ def main():
                         3,
                     ),
                     "phase_timings": v_timings,
+                    "backend_wedged": devicehealth.window_dirty(
+                        v_wedge_start
+                    ),
                 }
                 print(
                     f"[bench] {vcfg}+{vname}: {v_wall:.3f}s "
@@ -752,6 +766,11 @@ def main():
             "shards": SHARDS,
             "backend": backend_name,
             "backend_fell_back": BACKEND_FELL_BACK,
+            # true if ANY config saw the wedged latch (its walls are host
+            # numbers regardless of the backend label)
+            "backend_wedged_any": any(
+                r.get("backend_wedged") for r in results.values()
+            ),
             "n_devices": n_devices,
             "device_roundtrip_floor_s": (
                 None if floor_s is None else round(floor_s, 4)
@@ -788,6 +807,9 @@ def main():
                     "detail": {
                         "backend": full_detail["backend"],
                         "backend_fell_back": BACKEND_FELL_BACK,
+                        "backend_wedged_any": full_detail[
+                            "backend_wedged_any"
+                        ],
                         "n_devices": full_detail["n_devices"],
                         "rows": ROWS,
                         "shards": SHARDS,

@@ -264,9 +264,11 @@ def device_dispatch_floor(remeasure=False):
         # would hang the calling thread (historically the worker loop, via
         # the first query's routing) forever.  Run it sacrificially; a
         # deadline miss latches the backend and host routing takes over.
-        done, floor = devicehealth.run_with_deadline(
-            _measure, devicehealth.probe_timeout_s()
-        )
+        timeout = devicehealth.probe_timeout_s()
+        if timeout <= 0:  # detection disabled: measure directly
+            _measured_floor = _measure()
+            return _measured_floor
+        done, floor = devicehealth.run_with_deadline(_measure, timeout)
         if not done or floor is None:
             devicehealth.latch_wedged()
             return devicehealth.probe_timeout_s()

@@ -32,6 +32,7 @@ _wedged = False
 _probe_started = None     # monotonic start of the in-flight probe, or None
 _last_probe_start = 0.0   # start of the most recent probe, any outcome
 _abandoned = 0            # probes written off as hung since the last success
+_generation = 0           # incremented on every not-wedged -> wedged flip
 
 #: past this many parked probe threads, relaunch only every 10 intervals —
 #: a permanently dead backend must not grow a thread per interval forever
@@ -41,7 +42,9 @@ _MAX_ABANDONED_FAST = 16
 def probe_timeout_s():
     """Deadline for one trivial dispatch + fetch.  Generous: a tunneled
     first compile of even ``x + 1`` takes seconds, and a real wedge hangs
-    for minutes — 60 s cleanly separates the two."""
+    for minutes — 60 s cleanly separates the two.  ``0`` disables wedge
+    detection entirely (no probes, never latched): for benchmarks or
+    debugging where a hang is preferable to a silent host fallback."""
     return float(os.environ.get("BQUERYD_TPU_DEVICE_PROBE_TIMEOUT_S", 60))
 
 
@@ -64,8 +67,17 @@ def _default_probe():
 _probe_fn = _default_probe
 
 
+def _latch_locked():
+    """Set the latch (under _lock) and bump the generation on the
+    not-wedged -> wedged transition — the single place the rule lives."""
+    global _wedged, _generation
+    if not _wedged:
+        _generation += 1
+    _wedged = True
+
+
 def _probe_body(my_start):
-    global _probe_started, _wedged, _abandoned
+    global _probe_started, _wedged, _abandoned, _generation
     try:
         _probe_fn()
     except Exception:
@@ -75,7 +87,7 @@ def _probe_body(my_start):
         with _lock:
             if _probe_started == my_start:
                 _probe_started = None
-            _wedged = True
+            _latch_locked()
         return
     with _lock:
         # an abandoned probe that finally returns after the tunnel
@@ -114,12 +126,14 @@ def backend_wedged(launch=True):
     dispatch thread as a side effect would be wrong.  Such processes can
     only see the latch set by their own failed device calls — which is
     exactly the right scope."""
-    global _wedged, _probe_started, _abandoned
+    global _wedged, _probe_started, _abandoned, _generation
+    if probe_timeout_s() <= 0:
+        return False  # detection disabled: never latched, no probes
     now = time.monotonic()
     with _lock:
         if _probe_started is not None:
             if now - _probe_started > probe_timeout_s():
-                _wedged = True
+                _latch_locked()
                 # write the hung probe off so the clock can relaunch
                 _probe_started = None
                 _abandoned += 1
@@ -159,9 +173,27 @@ def latch_wedged():
     """Latch the backend as wedged on direct evidence (a device call that
     blew its deadline, e.g. the dispatch-floor measurement).  The interval
     clock keeps probing, so recovery stays automatic."""
-    global _wedged
     with _lock:
-        _wedged = True
+        _latch_locked()
+
+
+def wedge_marker():
+    """Snapshot for evidence windows: ``(generation, currently_wedged)``.
+    A measurement window is CLEAN iff the marker is identical before and
+    after AND neither end is wedged — a transient wedge that recovered
+    mid-window bumps the generation even though both endpoint reads of
+    ``backend_wedged`` say False."""
+    with _lock:
+        return (_generation, _wedged)
+
+
+def window_dirty(start_marker, end_marker=None):
+    """Whether a wedge overlapped the window between two markers."""
+    if end_marker is None:
+        end_marker = wedge_marker()
+    return (
+        start_marker != end_marker or start_marker[1] or end_marker[1]
+    )
 
 
 def force_state(wedged):

@@ -354,3 +354,23 @@ def test_wedged_cluster_serves_via_rpc(tmp_path):
         controller.stop()
         for t in threads:
             t.join(timeout=10)
+
+
+def test_wedge_marker_catches_transient_wedge():
+    """A wedge that latches and recovers INSIDE a window must dirty the
+    window even though both endpoint reads say not-wedged."""
+    clean_start = devicehealth.wedge_marker()
+    assert not devicehealth.window_dirty(clean_start)
+    devicehealth.latch_wedged()
+    devicehealth.force_state(False)  # recovered before the end read
+    assert devicehealth.backend_wedged(launch=False) is False
+    assert devicehealth.window_dirty(clean_start), (
+        "transient wedge inside the window must dirty it"
+    )
+
+
+def test_detection_disabled_by_zero_timeout(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_DEVICE_PROBE_TIMEOUT_S", "0")
+    # even a forced latch reads False while disabled, and no probe launches
+    devicehealth.force_state(True)
+    assert devicehealth.backend_wedged() is False

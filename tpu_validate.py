@@ -39,6 +39,9 @@ pd.set_option("future.infer_string", False)
 def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else "TPU_VALIDATE.json"
     t0 = time.time()
+    from bqueryd_tpu.utils import devicehealth
+
+    wedge_start = devicehealth.wedge_marker()
     import jax
 
     if os.environ.get("TPU_VALIDATE_FORCE_CPU") == "1":
@@ -485,6 +488,13 @@ def main():
     # operator-skipped cases are partial validation, same as a budget
     # truncation: the one-line gate must not read as a full pass
     report["cases_skipped"] = len(skip_cases)
+    # evidence integrity: engine/mesh cases host-route if the devicehealth
+    # latch flipped at ANY point in the run (the window marker catches a
+    # transient wedge that recovered before this line) — their walls are
+    # then host numbers
+    report["backend_wedged_during_run"] = devicehealth.window_dirty(
+        wedge_start
+    )
     report["complete"] = not over_budget and not skip_cases
     report["ok"] = failures == 0 and report["complete"]
     report["failures"] = failures
@@ -495,7 +505,8 @@ def main():
             {
                 k: report[k]
                 for k in (
-                    "backend", "ok", "complete", "failures", "cases_skipped"
+                    "backend", "ok", "complete", "failures",
+                    "cases_skipped", "backend_wedged_during_run",
                 )
             }
         )
