@@ -160,12 +160,17 @@ def _call(codes_flat, lhs, n_rows, n_groups, interpret):
 
 
 #: group-tile width of the high-cardinality kernel: one lane-multiple of
-#: output groups computed per outer grid step
-_HICARD_GT = 2048
+#: output groups computed per outer grid step.  Env-tunable for hardware
+#: sweeps (a fresh process per setting: the values freeze into each traced
+#: program signature).
+def _hicard_gt():
+    return int(os.environ.get("BQUERYD_TPU_PALLAS_HICARD_GT", 2048))
+
 
 #: inner K tile of the high-cardinality kernel ([KT, GT] bf16 one-hot =
 #: 2 MB VMEM at the defaults)
-_HICARD_KT = 512
+def _hicard_kt():
+    return int(os.environ.get("BQUERYD_TPU_PALLAS_HICARD_KT", 512))
 
 #: uint32 accumulator bound: every 8-bit limb row's TOTAL sum must stay
 #: below 2^32 (limb values <= 255), so rows beyond this need the caller to
@@ -185,12 +190,13 @@ def hicard_groups_limit():
 
 def hicard_fits_vmem(n_rows):
     """Whether ``n_rows`` stacked reduction rows fit the high-cardinality
-    kernel's VMEM plan (its group tile is fixed, so only the row count
-    scales the working set: double-buffered lhs blocks dominate)."""
+    kernel's VMEM plan under the current (env-tunable) tile sizes — the
+    double-buffered lhs blocks dominate as the row count grows."""
     rpad = _round_up(max(n_rows, 1), _SUBLANE)
+    kt, gt = _hicard_kt(), _hicard_gt()
     need = (
-        _HICARD_KT * _HICARD_GT * 2      # bf16 one-hot tile
-        + rpad * _HICARD_GT * 4 * 2      # i32 out block (+revisit headroom)
+        kt * gt * 2                      # bf16 one-hot tile
+        + rpad * gt * 4 * 2              # i32 out block (+revisit headroom)
         + 2 * rpad * BLOCK_K * 2         # double-buffered bf16 lhs block
         + 2 * BLOCK_K * 4                # double-buffered i32 codes block
     )
@@ -269,7 +275,21 @@ def onehot_rows_dot_hicard(codes, rows, n_rows, n_groups, interpret=False):
         )
     npad = _round_up(max(n, 1), BLOCK_K)
     rpad = _round_up(n_rows, _SUBLANE)
-    gpad = _round_up(n_groups, _HICARD_GT)
+    gt, kt = _hicard_gt(), _hicard_kt()
+    if (
+        kt < 128
+        or gt < 128
+        or BLOCK_K % kt != 0
+        or gt % 128 != 0
+    ):
+        # sweep-knob hygiene: a non-divisor KT silently drops rows in the
+        # inner loop; a non-lane-multiple GT breaks the output tiling.
+        # Positivity first: the modulo checks themselves divide by kt
+        raise ValueError(
+            f"invalid hicard tiles KT={kt} (must divide {BLOCK_K}, "
+            f">=128) / GT={gt} (must be a positive multiple of 128)"
+        )
+    gpad = _round_up(n_groups, gt)
     codes_p = jnp.pad(
         codes.astype(jnp.int32), (0, npad - n), constant_values=-1
     )
@@ -277,10 +297,10 @@ def onehot_rows_dot_hicard(codes, rows, n_rows, n_groups, interpret=False):
         rows.astype(jnp.bfloat16), ((0, rpad - n_rows), (0, npad - n))
     )
     nb = npad // BLOCK_K
-    ngt = gpad // _HICARD_GT
+    ngt = gpad // gt
     with jax.enable_x64(False):
         out = pl.pallas_call(
-            _make_hicard_kernel(_HICARD_KT, _HICARD_GT),
+            _make_hicard_kernel(kt, gt),
             out_shape=jax.ShapeDtypeStruct((rpad, gpad), jnp.int32),
             # row-block dim innermost: the output block stays resident in
             # VMEM while the whole row range accumulates into it
@@ -296,7 +316,7 @@ def onehot_rows_dot_hicard(codes, rows, n_rows, n_groups, interpret=False):
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (rpad, _HICARD_GT),
+                (rpad, gt),
                 lambda g, b: (0, g),
                 memory_space=pltpu.VMEM,
             ),

@@ -77,7 +77,7 @@ def _latch_locked():
 
 
 def _probe_body(my_start):
-    global _probe_started, _wedged, _abandoned, _generation
+    global _probe_started, _wedged, _abandoned
     try:
         _probe_fn()
     except Exception:
@@ -126,7 +126,7 @@ def backend_wedged(launch=True):
     dispatch thread as a side effect would be wrong.  Such processes can
     only see the latch set by their own failed device calls — which is
     exactly the right scope."""
-    global _wedged, _probe_started, _abandoned, _generation
+    global _probe_started, _abandoned
     if probe_timeout_s() <= 0:
         return False  # detection disabled: never latched, no probes
     now = time.monotonic()
