@@ -701,15 +701,13 @@ class QueryEngine:
             else:
                 # rows still needed to drop empty groups
                 if devicehealth.backend_wedged():
-                    # host bincount with partial_tables' exact semantics
-                    # (negative codes dropped, mask applied)
-                    d = (
-                        dense
-                        if mask_arr is None
-                        else np.where(mask_arr, dense, -1)
-                    )
-                    rows = np.bincount(
-                        d[d >= 0].astype(np.int64), minlength=n_groups
+                    # the numpy twin shares partial_tables' exact row
+                    # semantics (negative codes dropped, mask applied)
+                    rows = np.asarray(
+                        ops.host_partial_tables(
+                            dense.astype(np.int32), (), (), n_groups,
+                            mask_arr,
+                        )["rows"]
                     )[:n_groups]
                 else:
                     rows = np.asarray(

@@ -546,13 +546,16 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
         elif op in ("min", "max"):
             plans.append((op, op, values, present_row, null))
 
-    if use_pallas == "hicard":
+    # resolve the contraction route now that the stacked row count is
+    # known (all static python: len(rows) and n_groups are trace-time
+    # constants).  The dispatcher's gates only knew n_groups.
+    route = {False: "xla", True: "pallas", "hicard": "hicard"}[use_pallas]
+    if route == "hicard":
         from bqueryd_tpu.ops import pallas_groupby
 
-        # the dispatcher estimated the row count; the exact count is known
-        # here — past the VMEM plan the scatter path must take over (NOT
-        # the XLA dot below, whose [nb, K, G] one-hot materializes
-        # gigabytes at this cardinality)
+        # past the VMEM plan the scatter path must take over (NOT the XLA
+        # dot below, whose [nb, K, G] one-hot materializes gigabytes at
+        # this cardinality)
         if not (
             pallas_groupby.hicard_fits_vmem(len(rows))
             and not float_rows
@@ -561,6 +564,15 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
                 codes, measures, ops, n_groups, mask,
                 null_sentinels=null_sentinels,
             )
+    elif route == "pallas":
+        from bqueryd_tpu.ops import pallas_groupby
+
+        # demote to the XLA dot when the full working set (rows x groups
+        # scratch + lhs blocks) would overflow VMEM
+        if not pallas_groupby.fits_vmem(len(rows), n_groups):
+            route = "xla"
+
+    if route == "hicard":
         # group-tiled fused kernel: [R, G] uint32 limb totals mod 2^32,
         # zero-extended so the downstream uint64 recombination is unchanged
         # (the sum over the singleton block axis is a no-op)
@@ -571,18 +583,7 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
             n_groups=n_groups,
             interpret=jax.default_backend() != "tpu",
         )[None, : len(rows), :n_groups]
-    elif use_pallas:
-        from bqueryd_tpu.ops import pallas_groupby
-
-        # the dispatcher's gate only knew n_groups; the stacked row count is
-        # known here, so demote to the XLA dot when the full working set
-        # (rows x groups scratch + lhs blocks) would overflow VMEM.  Static
-        # python branch: len(rows) and n_groups are trace-time constants.
-        if not pallas_groupby.fits_vmem(len(rows), n_groups):
-            use_pallas = False
-    if use_pallas and use_pallas != "hicard":
-        from bqueryd_tpu.ops import pallas_groupby
-
+    elif route == "pallas":
         # fused VMEM kernel: one-hot tiles formed on the fly, never in HBM
         out = pallas_groupby.onehot_rows_dot(
             folded,
@@ -591,7 +592,7 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
             n_groups=n_groups,
             interpret=jax.default_backend() != "tpu",
         )[:, : len(rows), :n_groups]
-    elif use_pallas != "hicard":
+    else:
         lhs = jnp.stack(
             [_blocked(r, nb, pad) for r in rows], axis=1
         )  # [nb,R,K]

@@ -71,12 +71,9 @@ def term_mask(values, op, value):
 
     if devicehealth.backend_wedged():
         import numpy as xp
-
-        values = xp.asarray(values)
     else:
         import jax.numpy as xp
-
-        values = xp.asarray(values)
+    values = xp.asarray(values)
     if op == "==":
         return values == value
     if op == "!=":
