@@ -283,12 +283,18 @@ def test_prepare_wrm_carries_backend_wedged():
     assert worker.prepare_wrm()["backend_wedged"] is True
 
 
-def test_wedged_cluster_serves_via_rpc(tmp_path):
+def test_wedged_cluster_serves_via_rpc(tmp_path, monkeypatch):
     """Full-stack degraded mode: a live (threads-as-nodes) cluster with the
     backend latched answers an RPC groupby exactly, and rpc.info() shows
     the worker advertising backend_wedged."""
     import logging
     import os
+
+    # the JAX warmup daemon thread is pointless here (the backend is
+    # latched) and a thread mid-compile at this short session's interpreter
+    # exit aborts pthread teardown ("FATAL: exception not rethrown" —
+    # the known gotcha; same pin as test_cluster_resilience)
+    monkeypatch.setenv("BQUERYD_TPU_WARMUP", "0")
 
     from bqueryd_tpu.controller import ControllerNode
     from bqueryd_tpu.rpc import RPC
