@@ -470,10 +470,17 @@ def main():
     cold_enabled = os.environ.get("BENCH_COLD", "1") == "1"
     # the main-loop configs measure the default XLA kernel path; a pre-set
     # opt-in flag would silently turn the route-vs-route comparisons below
-    # (xla-vs-pallas, scatter-vs-forced-matmul) into self-comparisons
+    # (xla-vs-pallas, scatter-vs-forced-matmul, adaptive-vs-static) into
+    # self-comparisons — or, for a pre-set BQUERYD_TPU_PLANNER=0, let the
+    # per-repeat pop in the planner section clobber the user's setting and
+    # mix routes mid-measurement
     prior_env = {
         flag: os.environ.pop(flag, None)
-        for flag in ("BQUERYD_TPU_PALLAS", "BQUERYD_TPU_FORCE_MATMUL")
+        for flag in (
+            "BQUERYD_TPU_PALLAS",
+            "BQUERYD_TPU_FORCE_MATMUL",
+            "BQUERYD_TPU_PLANNER",
+        )
     }
     base_dfs = {}  # per-config baseline frames for the variant gates
     try:
@@ -732,6 +739,118 @@ def main():
                 # finally restores every prior after the whole loop
                 os.environ.pop(vflag, None)
 
+        # planner config: the adaptive (plan-driven) route vs the static
+        # fan-out (BQUERYD_TPU_PLANNER=0) on the headline + highcard
+        # configs — the main-loop numbers ARE the adaptive route (planner
+        # on by default) — plus a plan-time pruning probe whose filter no
+        # shard can match: the counter must move and no dispatch may occur.
+        planner_detail = {}
+        if os.environ.get("BENCH_PLANNER", "1") == "1" and not wedged:
+            controller_node = nodes[0]
+            for pcfg in ("sharded", "highcard"):
+                if pcfg not in completed:
+                    continue
+                files, gcols, aggs, where = config_query(pcfg, names)
+                # adaptive and static measured BACK-TO-BACK, interleaved per
+                # repeat: the main-loop adaptive wall was taken minutes
+                # earlier under different cache/clock conditions, which made
+                # an identical-program comparison read as a route difference
+                try:
+                    a_walls, s_walls = [], []
+                    rpc.groupby(files, gcols, aggs, where)  # warmup
+                    # more repeats than the headline configs: adaptive and
+                    # static compile to the SAME program on backends that
+                    # normalize hints, so the comparison is noise-bounded —
+                    # a loose min reads scheduler jitter as a route delta
+                    for _ in range(max(REPEATS, 5)):
+                        t0 = time.perf_counter()
+                        a_result = rpc.groupby(files, gcols, aggs, where)
+                        a_walls.append(time.perf_counter() - t0)
+                        os.environ["BQUERYD_TPU_PLANNER"] = "0"
+                        try:
+                            t0 = time.perf_counter()
+                            s_result = rpc.groupby(files, gcols, aggs, where)
+                            s_walls.append(time.perf_counter() - t0)
+                        finally:
+                            os.environ.pop("BQUERYD_TPU_PLANNER", None)
+                    adaptive_wall = min(a_walls)
+                    static_wall = min(s_walls)
+                    check_result(
+                        a_result, base_dfs[pcfg], gcols, aggs,
+                        f"{pcfg}+adaptive",
+                    )
+                    check_result(
+                        s_result, base_dfs[pcfg], gcols, aggs,
+                        f"{pcfg}+static",
+                    )
+                except Exception as exc:
+                    print(
+                        f"[bench] planner variant {pcfg} failed: {exc!r}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    continue
+                planner_detail[pcfg] = {
+                    "adaptive_wall_s": round(adaptive_wall, 4),
+                    "main_loop_wall_s": results[pcfg]["framework_wall_s"],
+                    "static_wall_s": round(static_wall, 4),
+                    # the forced-matmul variant wall (measured above when the
+                    # route flag applies): the regression the planner path
+                    # must keep unreachable
+                    "forced_matmul_wall_s": results.get(
+                        f"{pcfg}_forced_matmul", {}
+                    ).get("framework_wall_s"),
+                }
+                print(
+                    f"[bench] planner {pcfg}: adaptive {adaptive_wall:.3f}s "
+                    f"vs static {static_wall:.3f}s",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            try:
+                before_pruned = controller_node.counters[
+                    "plan_pruned_shards"
+                ]
+                before_disp = controller_node.counters["dispatched_shards"]
+                probe = rpc.groupby(
+                    names,
+                    ["passenger_count"],
+                    [["fare_amount", "sum", "fare_amount"]],
+                    # PULocationID tops out at 265: every shard's min/max
+                    # stats exclude this, so the planner must dispatch NOTHING
+                    [["PULocationID", ">", 10_000]],
+                )
+                planner_detail["prune_probe"] = {
+                    "plan_pruned_shards": controller_node.counters[
+                        "plan_pruned_shards"
+                    ] - before_pruned,
+                    "dispatched_shards": controller_node.counters[
+                        "dispatched_shards"
+                    ] - before_disp,
+                    "result_rows": int(len(probe)),
+                }
+                print(
+                    f"[bench] prune probe: "
+                    f"{planner_detail['prune_probe']}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as exc:
+                print(
+                    f"[bench] prune probe failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            planner_detail["plan_counters"] = dict(controller_node.counters)
+            planner_detail["note"] = (
+                "on this backend every planner hint normalizes to the same "
+                "compiled program as the static route (executor."
+                "_effective_mesh_strategy), so adaptive-vs-static wall "
+                "deltas are run-to-run noise; the planner's wins here are "
+                "pruning (prune_probe) and never taking the forced-matmul "
+                "route"
+            )
+
         if HEADLINE in completed:
             head_name = HEADLINE
         elif completed:
@@ -776,6 +895,9 @@ def main():
                 None if floor_s is None else round(floor_s, 4)
             ),
             "configs": results,
+            # adaptive-vs-static route walls + the plan_pruned_shards /
+            # shared-dispatch / admission counters from the controller
+            "planner": planner_detail,
             "total_s": round(time.time() - t_start, 1),
         }
         with open(detail_path, "w") as f:
@@ -819,6 +941,9 @@ def main():
                             else round(floor_s * 1e3, 1)
                         ),
                         "configs": compact_configs,
+                        "plan_pruned_shards": planner_detail.get(
+                            "plan_counters", {}
+                        ).get("plan_pruned_shards"),
                         "total_s": full_detail["total_s"],
                     },
                 }

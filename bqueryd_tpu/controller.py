@@ -75,6 +75,9 @@ class ControllerNode:
         dispatch_timeout=DISPATCH_TIMEOUT,
         dispatch_hard_timeout=DISPATCH_HARD_TIMEOUT,
         port_range=(14300, 14400),
+        admit_max_active=None,
+        admit_queue_depth=None,
+        admit_client_quota=None,
     ):
         import logging
 
@@ -111,6 +114,30 @@ class ControllerNode:
         self._affinity_rr = 0
         self.rpc_segments = {}        # parent_token -> fan-out bookkeeping
         self.inflight = {}            # shard token -> dict(worker, sent_at, msg, parent)
+        # -- planning & admission state -------------------------------------
+        from bqueryd_tpu.plan import AdmissionController
+
+        self.admission = AdmissionController(
+            max_active=admit_max_active,
+            queue_depth=admit_queue_depth,
+            client_quota=admit_client_quota,
+        )
+        self._admitting = False
+        self._ticket_sigs = {}        # live ticket -> plan signature
+        self.shard_stats = {}         # filename -> advertised planning stats
+        self._work_subscribers = {}   # shard token -> [parent_token, ...]
+        self._work_keys = {}          # shard token -> shared-dispatch key
+        self._work_index = {}         # shared-dispatch key -> shard token
+        self.counters = {
+            "plan_pruned_shards": 0,      # shards excluded at plan time
+            "plan_shared_dispatches": 0,  # fused identical-work dispatches
+            "plan_strategy_hints": 0,     # non-auto kernel hints issued
+            "admission_busy": 0,          # BUSY backpressure replies
+            "admission_queued": 0,        # plans held in the wait queue
+            "admission_superseded": 0,    # abandoned queries retired early
+            "deadline_expired": 0,        # work expired before running
+            "dispatched_shards": 0,       # groupby CalcMessages sent out
+        }
         self.msg_count_in = 0
         self.start_time = time.time()
         self.running = False
@@ -172,6 +199,7 @@ class ControllerNode:
                             except zmq.Again:
                                 break
                             self.handle_in(frames)
+                    self._admit_ready()
                     self.dispatch_pending()
                 except Exception:
                     self.logger.exception("error in controller loop")
@@ -281,11 +309,28 @@ class ControllerNode:
             self.files_map[filename].discard(worker_id)
             if not self.files_map[filename]:
                 del self.files_map[filename]
+                self.shard_stats.pop(filename, None)
         # re-queue anything in flight on that worker
         for token, entry in list(self.inflight.items()):
             if entry["worker"] == worker_id:
                 self.inflight.pop(token)
                 self._requeue(entry)
+
+    def _absorb_shard_stats(self, info):
+        """Planning stats ride the WRM; keep the freshest copy per shard.
+        Entries are shape-checked here: one malformed advertisement (a
+        version-skewed or buggy worker) must poison at most its own shard's
+        stats, never a query — downstream consumers assume dicts."""
+        stats = info.get("shard_stats")
+        if not isinstance(stats, dict):
+            return
+        for fname, entry in stats.items():
+            if (
+                isinstance(fname, str)
+                and isinstance(entry, dict)
+                and isinstance(entry.get("cols", {}), dict)
+            ):
+                self.shard_stats[fname] = entry
 
     # -- scheduling --------------------------------------------------------
     def find_free_worker(self, needs_local=False, filename=None):
@@ -325,6 +370,14 @@ class ControllerNode:
                     self.worker_out_messages.pop(affinity, None)
                 continue
             msg = queue[0]
+            if msg.deadline_expired():
+                # nobody is waiting anymore: expire instead of dispatching
+                queue.pop(0)
+                self.counters["deadline_expired"] += 1
+                self._abort_work(
+                    msg, "deadline exceeded before dispatch"
+                )
+                continue
             worker_id = msg.get("worker_id") or self.find_free_worker(
                 needs_local=msg.get("needs_local", False),
                 filename=msg.get("filename"),
@@ -341,8 +394,8 @@ class ControllerNode:
                     # no future tick can serve this — fail fast instead of
                     # head-of-line-blocking the queue forever
                     queue.pop(0)
-                    self.abort_parent(
-                        msg.get("parent_token"),
+                    self._abort_work(
+                        msg,
                         f"file(s) no longer on any worker: "
                         f"{[f for f in needed if f not in self.files_map]}",
                     )
@@ -353,7 +406,9 @@ class ControllerNode:
                     # worker died): re-split the group into per-shard
                     # messages, which the normal scheduler can place
                     queue.pop(0)
-                    queue.extend(self._split_batch(msg))
+                    children = self._split_batch(msg)
+                    self._transfer_work(msg, children)
+                    queue.extend(children)
                 continue  # retry next tick
             queue.pop(0)
             self._send_to_worker(worker_id, msg)
@@ -381,6 +436,52 @@ class ControllerNode:
             children.append(child)
         return children
 
+    # -- shared-dispatch work tracking -------------------------------------
+    # Every groupby work unit (one CalcMessage) carries a subscriber list:
+    # the parent queries awaiting its payload.  Two concurrent admitted
+    # plans that need the same computation over the same shard group fuse
+    # into ONE dispatch — one column read, one device transfer, one kernel
+    # run — and the result fans out to every subscriber (multi-query
+    # batching; observable via counters["plan_shared_dispatches"]).
+    def _register_work(self, msg, subscribers, work_key=None):
+        token = msg.get("token")
+        if not token:
+            return
+        self._work_subscribers[token] = list(subscribers)
+        if work_key is not None:
+            self._work_keys[token] = work_key
+            self._work_index[work_key] = token
+
+    def _drop_work(self, token):
+        self._work_subscribers.pop(token, None)
+        key = self._work_keys.pop(token, None)
+        if key is not None and self._work_index.get(key) == token:
+            self._work_index.pop(key, None)
+
+    def _work_parents(self, msg):
+        """Every parent awaiting this work unit (shared dispatch aware)."""
+        subs = self._work_subscribers.get(msg.get("token"))
+        if subs:
+            return list(subs)
+        parent = msg.get("parent_token")
+        return [parent] if parent else []
+
+    def _transfer_work(self, msg, children):
+        """Re-home a batch's subscribers onto its re-split children."""
+        subs = self._work_subscribers.get(msg.get("token"))
+        self._drop_work(msg.get("token"))
+        if subs is None:
+            return
+        for child in children:
+            self._register_work(child, subs)
+
+    def _abort_work(self, msg, error_text):
+        """Fail every parent subscribed to one work unit."""
+        parents = self._work_parents(msg)
+        self._drop_work(msg.get("token"))
+        for parent in parents:
+            self.abort_parent(parent, error_text)
+
     def _send_to_worker(self, worker_id, msg):
         try:
             self.socket.send_multipart(
@@ -404,6 +505,8 @@ class ControllerNode:
                 charge_retry=not unroutable,
             )
             return
+        if msg.isa("groupby"):
+            self.counters["dispatched_shards"] += 1
         if worker_id in self.worker_map:
             self.worker_map[worker_id]["busy"] = True
             # a successful dispatch is proof of liveness: the send would have
@@ -461,10 +564,9 @@ class ControllerNode:
     def _requeue(self, entry, charge_retry=True):
         msg = entry["msg"]
         retries = entry.get("retries", 0)
-        parent = entry.get("parent") or msg.get("parent_token")
         if charge_retry and retries >= MAX_DISPATCH_RETRIES:
-            self.abort_parent(
-                parent,
+            self._abort_work(
+                msg,
                 f"shard {msg.get('filename')} failed after "
                 f"{retries} retries (worker lost or timed out)",
             )
@@ -529,6 +631,11 @@ class ControllerNode:
                 known = self.worker_map.get(worker_id)
                 if known is not None:
                     known["last_seen"] = now
+                    # the worker's one-shot stats advertisement may ride
+                    # EITHER socket (the liveness thread races the main
+                    # loop for it); dropping it here would suppress fresh
+                    # stats for a whole re-advertise window
+                    self._absorb_shard_stats(msg)
                 elif self._adoption_blocked.get(worker_id, 0) > now:
                     # quarantined: this worker was hard-culled as an hb_only
                     # adoptee whose main loop never spoke — its heartbeat
@@ -551,6 +658,7 @@ class ControllerNode:
                     self.worker_map[worker_id] = info
                     for filename in info.get("data_files") or []:
                         self.files_map.setdefault(filename, set()).add(worker_id)
+                    self._absorb_shard_stats(info)
                 return
             prev = self.worker_map.get(worker_id, {})
             self._adoption_blocked.pop(worker_id, None)  # main loop is back
@@ -568,6 +676,8 @@ class ControllerNode:
                     self.files_map[filename].discard(worker_id)
                     if not self.files_map[filename]:
                         del self.files_map[filename]
+                        self.shard_stats.pop(filename, None)
+            self._absorb_shard_stats(info)
             return
         if worker_id not in self.worker_map:
             # a message from a culled worker: ask it to re-register by just
@@ -603,7 +713,9 @@ class ControllerNode:
     # -- results sink ------------------------------------------------------
     def process_worker_result(self, msg):
         parent = msg.get("parent_token")
-        if parent is None:
+        token = msg.get("token")
+        subscribers = self._work_subscribers.get(token)
+        if parent is None and not subscribers:
             # single-segment RPC (execute_code, sleep, readfile): a binary
             # data frame is folded into the JSON reply as base64
             data = msg.pop("data", None)
@@ -611,58 +723,111 @@ class ControllerNode:
                 msg.add_as_binary("result", data)
             self.reply_rpc_message(msg.get("token"), msg)
             return
-        segment = self.rpc_segments.get(parent)
-        if segment is None:
-            self.logger.warning("orphaned result for parent %s dropped", parent)
-            return
+        self._drop_work(token)
+        parents = list(subscribers) if subscribers else [parent]
         if msg.isa(ErrorMessage):
-            self.abort_parent(parent, msg.get("payload"))
+            for p in parents:
+                self.abort_parent(p, msg.get("payload"))
             return
         filename = msg.get("filename")
         # a batched shard-group reply covers several filenames with ONE
         # already-merged payload (the worker's on-device psum merge);
         # completion is counted in covered filenames, not replies
         key = tuple(filename) if isinstance(filename, list) else (filename,)
-        segment["results"][key] = msg.get("data") or b""
-        segment["timings"][key] = msg.get("phase_timings")
-        covered = sum(len(k) for k in segment["results"])
-        if covered == len(segment["filenames"]):
-            self.rpc_segments.pop(parent)
-            # payloads in requested-filename order (not reply-arrival order):
-            # the aggregate=False rows path concatenates payloads client-side,
-            # and the reference's row order is deterministic by filename
-            covering = {
-                f: k for k in segment["results"] for f in k
-            }
-            payloads, seen = [], set()
-            for f in segment["filenames"]:
-                k = covering[f]
-                if k not in seen:
-                    seen.add(k)
-                    payloads.append(segment["results"][k])
-            # compact key: a batched shard-group is labelled by its first
-            # file + count, not the joined list (a 10-shard join produced a
-            # 130+ char key that bloated the bench's one-line JSON past what
-            # log tails keep intact)
-            timings = {
-                (k[0] if len(k) == 1 else f"{k[0]}+{len(k) - 1}more"): v
-                for k, v in segment["timings"].items()
-            }
-            reply = pickle.dumps(
-                {"ok": True, "payloads": payloads, "timings": timings},
-                protocol=4,
-            )
-            self.reply_rpc_raw(segment["client_token"], reply)
+        delivered = False
+        for p in parents:
+            segment = self.rpc_segments.get(p)
+            if segment is None:
+                continue  # that subscriber aborted earlier
+            delivered = True
+            segment["results"][key] = msg.get("data") or b""
+            segment["timings"][key] = msg.get("phase_timings")
+            self._maybe_complete_segment(p)
+        if not delivered:
+            self.logger.warning("orphaned result for parent %s dropped", parent)
 
-    def abort_parent(self, parent, error_text):
+    def _maybe_complete_segment(self, parent):
+        """Reply to the client once every requested shard is covered (by a
+        worker payload, a batched group payload, or a plan-time prune)."""
+        segment = self.rpc_segments.get(parent)
+        if segment is None:
+            return
+        covered = sum(len(k) for k in segment["results"])
+        if covered < len(segment["filenames"]):
+            return
+        self.rpc_segments.pop(parent)
+        # payloads in requested-filename order (not reply-arrival order):
+        # the aggregate=False rows path concatenates payloads client-side,
+        # and the reference's row order is deterministic by filename
+        covering = {
+            f: k for k in segment["results"] for f in k
+        }
+        payloads, seen = [], set()
+        for f in segment["filenames"]:
+            k = covering[f]
+            if k not in seen:
+                seen.add(k)
+                payloads.append(segment["results"][k])
+        # compact key: a batched shard-group is labelled by its first
+        # file + count, not the joined list (a 10-shard join produced a
+        # 130+ char key that bloated the bench's one-line JSON past what
+        # log tails keep intact)
+        timings = {
+            (k[0] if len(k) == 1 else f"{k[0]}+{len(k) - 1}more"): v
+            for k, v in segment["timings"].items()
+        }
+        reply = pickle.dumps(
+            {"ok": True, "payloads": payloads, "timings": timings},
+            protocol=4,
+        )
+        self._finish_segment(parent, segment, reply)
+
+    def _finish_segment(self, parent, segment, reply_bytes=None):
+        """Final reply for a groupby parent + admission slot release.
+        ``reply_bytes=None`` finishes silently (a cancelled query whose
+        client is no longer waiting — replying would mis-pair with the
+        identity's next request)."""
+        if reply_bytes is not None:
+            self.reply_rpc_raw(segment["client_token"], reply_bytes)
+        ticket = segment.get("admission_ticket")
+        if ticket is not None:
+            self.admission.release(ticket)
+            self._ticket_sigs.pop(ticket, None)
+            self._admit_ready()
+
+    def abort_parent(self, parent, error_text, reply=True):
         segment = self.rpc_segments.pop(parent, None)
         if segment is None:
             return
-        # drop queued siblings of the aborted query
+        # detach this parent from shared work units; units with no remaining
+        # subscriber die, shared ones keep computing for their other parents
+        dead = set()
+        for token, subs in list(self._work_subscribers.items()):
+            if parent in subs:
+                subs[:] = [p for p in subs if p != parent]
+                if not subs:
+                    dead.add(token)
+                    self._drop_work(token)
+        for token in dead:
+            self.inflight.pop(token, None)
+        # drop queued siblings of the aborted query (shared units survive
+        # via their live subscriber list)
         for queue in self.worker_out_messages.values():
-            queue[:] = [m for m in queue if m.get("parent_token") != parent]
-        reply = pickle.dumps({"ok": False, "error": str(error_text)}, protocol=4)
-        self.reply_rpc_raw(segment["client_token"], reply)
+            queue[:] = [
+                m for m in queue
+                if m.get("token") not in dead
+                and not (
+                    m.get("parent_token") == parent
+                    and m.get("token") not in self._work_subscribers
+                )
+            ]
+        self._finish_segment(
+            parent,
+            segment,
+            pickle.dumps(
+                {"ok": False, "error": str(error_text)}, protocol=4
+            ) if reply else None,
+        )
 
     def reply_rpc_raw(self, client_token, payload_bytes):
         client = binascii.unhexlify(client_token)
@@ -731,6 +896,9 @@ class ControllerNode:
             },
             "inflight": len(self.inflight),
             "rpc_segments": len(self.rpc_segments),
+            "counters": dict(self.counters),
+            "admission": self.admission.stats(),
+            "shard_stats_known": len(self.shard_stats),
         }
         if include_peers:
             info["others"] = self.others
@@ -860,45 +1028,263 @@ class ControllerNode:
             reply["ticket"] = ticket
             self.reply_rpc_message(segment["client_token"], reply)
 
-    # -- groupby fan-out ---------------------------------------------------
+    # -- groupby planning, admission & fan-out -----------------------------
     def rpc_groupby(self, msg):
+        """Admission-controlled, plan-driven groupby.
+
+        The verb no longer fans out verbatim: it compiles to a
+        :class:`~bqueryd_tpu.plan.LogicalPlan` (rewrites applied), passes
+        admission control (explicit BUSY backpressure instead of unbounded
+        inflight growth), and launches via :meth:`_launch_plan`, which
+        prunes shards against advertised stats, fuses identical concurrent
+        work, and stamps each dispatch with a kernel-strategy hint."""
+        from bqueryd_tpu import plan as planmod
+
         args, kwargs = msg.get_args_kwargs()
         if len(args) != 4:
             raise ValueError(
                 "groupby needs (filenames, groupby_cols, agg_list, where_terms)"
             )
         filenames, groupby_cols, agg_list, where_terms = args
-        if isinstance(filenames, str):
-            filenames = [filenames]
-        # dedup, order-preserving: duplicates would double-count on the
-        # batched path and deadlock the per-shard path (both replies collapse
-        # onto one result key, so the segment never completes)
-        filenames = list(dict.fromkeys(filenames))
-        unknown = [f for f in filenames if f not in self.files_map]
+        # dedup, order-preserving (inside plan compilation): duplicates would
+        # double-count on the batched path and deadlock the per-shard path
+        plan = planmod.plan_groupby(
+            filenames, groupby_cols, agg_list, where_terms,
+            aggregate=kwargs.get("aggregate", True),
+            expand_filter_column=kwargs.get("expand_filter_column"),
+        )
+        unknown = [f for f in plan.filenames if f not in self.files_map]
         if unknown:
             raise ValueError(f"filenames not found on any worker: {unknown}")
 
+        # admission: the REQ token is the ticket (one live ticket per
+        # lockstep REQ socket); the quota key is the client-declared
+        # client_id when present, so one application's many sockets share
+        # one quota bucket
+        quota_key = msg.get("client_id") or msg["token"]
+        # deadline/priority are deliberately NOT part of the resend
+        # signature: an application-level retry restamps a fresh absolute
+        # deadline, and reading that as a *new* query would cancel and
+        # restart the in-flight run on every retry — a livelock for any
+        # query longer than the retry interval.  An identical resend joins
+        # the in-flight run; that run's (earlier) deadline governs.
+        req_sig = (tuple(plan.filenames), plan.signature())
+        decision = self.admission.submit(
+            ticket_id=msg["token"],
+            client=quota_key,
+            priority=msg.get("priority", 0),
+            deadline=msg.get("deadline"),
+            payload=(msg, plan, kwargs),
+        )
+        if (
+            decision == planmod.DUPLICATE
+            and self._ticket_sigs.get(msg["token"]) != req_sig
+        ):
+            # a DIFFERENT query on a live identity: the REQ socket is
+            # lockstep, so the client has abandoned the earlier query — its
+            # reply would mis-pair with this request.  Retire the abandoned
+            # run silently and admit this one in its place.
+            self.counters["admission_superseded"] += 1
+            self._cancel_ticket(msg["token"])
+            decision = self.admission.submit(
+                ticket_id=msg["token"],
+                client=quota_key,
+                priority=msg.get("priority", 0),
+                deadline=msg.get("deadline"),
+                payload=(msg, plan, kwargs),
+            )
+        if decision == planmod.BUSY:
+            self.counters["admission_busy"] += 1
+            self.reply_rpc_raw(
+                msg["token"],
+                pickle.dumps(
+                    {
+                        "ok": False,
+                        "busy": True,
+                        "error": "BUSY: admission queue full or client "
+                                 "quota exceeded; retry with backoff",
+                    },
+                    protocol=4,
+                ),
+            )
+            return
+        if decision == planmod.QUEUED:
+            self._ticket_sigs[msg["token"]] = req_sig
+            self.counters["admission_queued"] += 1
+            return  # launched later by _admit_ready
+        if decision == planmod.DUPLICATE:
+            # a client retrying after its own timeout resent the identical
+            # query on a live ticket: the in-flight run will answer this
+            # identity; launching a second fan-out would double the work
+            # outside the admission bound and queue a stale extra reply
+            # for the client's NEXT call
+            self.logger.info(
+                "duplicate groupby from client %s ignored (already running)",
+                msg["token"][:12],
+            )
+            return
+        self._ticket_sigs[msg["token"]] = req_sig
+        try:
+            self._launch_plan(msg, plan, kwargs)
+        except Exception:
+            self.admission.release(msg["token"])
+            self._ticket_sigs.pop(msg["token"], None)
+            raise
+
+    def _cancel_ticket(self, ticket):
+        """Silently retire a live ticket whose client has moved on: an
+        active run is detached from its work units and finished with no
+        reply (replying would mis-pair with the identity's next request);
+        a still-queued one is dropped before it ever launches."""
+        parent = next(
+            (
+                p for p, s in self.rpc_segments.items()
+                if s.get("admission_ticket") == ticket
+            ),
+            None,
+        )
+        if parent is not None:
+            self.abort_parent(parent, "superseded", reply=False)
+        elif self.admission.release(ticket):
+            self._ticket_sigs.pop(ticket, None)
+
+    def _admit_ready(self):
+        """Launch queued plans into freed capacity; expire stale ones."""
+        if self._admitting:
+            return  # re-entered via a completion inside _launch_plan
+        self._admitting = True
+        try:
+            while True:
+                launch, expired = self.admission.pop_ready()
+                if not launch and not expired:
+                    return
+                for payload in expired:
+                    msg, _plan, _kwargs = payload
+                    self._ticket_sigs.pop(msg["token"], None)
+                    self.counters["deadline_expired"] += 1
+                    self.reply_rpc_raw(
+                        msg["token"],
+                        pickle.dumps(
+                            {
+                                "ok": False,
+                                "error": "deadline exceeded while queued "
+                                         "for admission",
+                            },
+                            protocol=4,
+                        ),
+                    )
+                for payload in launch:
+                    msg, plan, kwargs = payload
+                    try:
+                        self._launch_plan(msg, plan, kwargs)
+                    except Exception as exc:
+                        self.logger.exception("queued plan launch failed")
+                        self.admission.release(msg["token"])
+                        self._ticket_sigs.pop(msg["token"], None)
+                        self.reply_rpc_raw(
+                            msg["token"],
+                            pickle.dumps(
+                                {"ok": False, "error": f"{exc}"},
+                                protocol=4,
+                            ),
+                        )
+        finally:
+            self._admitting = False
+
+    def _launch_plan(self, msg, plan, kwargs):
+        from bqueryd_tpu import plan as planmod
+
         parent_token = os.urandom(8).hex()
-        affinity = kwargs.get("affinity")
-        self.rpc_segments[parent_token] = {
+        planner_on = planmod.planner_enabled()
+
+        # plan-time shard pruning: a shard whose advertised min/max stats
+        # exclude the pushed-down predicate conjunction is never dispatched —
+        # its (provably empty) payload slot is pre-filled so the client-side
+        # merge contract is unchanged
+        keep, pruned = [], []
+        for f in plan.filenames:
+            stats = self.shard_stats.get(f)
+            if (
+                planner_on
+                and plan.scan.pushdown
+                and stats is not None
+                and not planmod.stats_can_match(stats, plan.scan.pushdown)
+            ):
+                pruned.append(f)
+            else:
+                keep.append(f)
+        self.counters["plan_pruned_shards"] += len(pruned)
+
+        segment = {
             "client_token": msg["token"],
             "msg": msg,
-            "filenames": list(filenames),
-            "results": {},
+            "filenames": list(plan.filenames),
+            "results": {(f,): b"" for f in pruned},
             "timings": {},
             "created": time.time(),
+            "admission_ticket": msg["token"],
+            "pruned": list(pruned),
         }
+        self.rpc_segments[parent_token] = segment
+        if not keep:
+            # every shard pruned: answer immediately with empty payloads
+            self._maybe_complete_segment(parent_token)
+            return
+        try:
+            self._dispatch_plan(msg, plan, kwargs, parent_token, keep)
+        except Exception:
+            # a half-launched parent can never complete (its later groups
+            # were never queued): leaving it would leak the segment, its
+            # work-unit registrations, and worker time on the groups that
+            # DID queue — detach them all; the caller replies the error
+            self.abort_parent(parent_token, "launch failed", reply=False)
+            raise
+
+    def _dispatch_plan(self, msg, plan, kwargs, parent_token, keep):
+        from bqueryd_tpu import plan as planmod
+
+        affinity = kwargs.get("affinity")
+        planner_on = planmod.planner_enabled()
+        groupby_cols = list(plan.groupby.keys)
+        agg_list = plan.physical_agg_list()
+        where_terms = plan.where_terms
         # single-shard queries produce exactly one payload with no merge
         # downstream: workers may finalize representation-heavy aggregations
         # (count_distinct) on device instead of shipping mergeable sets
-        sole = len(filenames) == 1 and kwargs.get("aggregate", True)
+        sole = len(keep) == 1 and plan.aggregate_rows
+        plan_sig = plan.signature()  # group-invariant: computed once
         for group in self._shard_groups(
-            filenames, groupby_cols, agg_list, kwargs
+            keep, groupby_cols, agg_list, kwargs
         ):
+            target = group if len(group) > 1 else group[0]
+            # cost-based kernel-strategy selection from advertised stats;
+            # "auto" (no stats / ambiguous economics) is the static default
+            strategy = None
+            if planner_on:
+                strategy, _est, _rows = planmod.select_for_group(
+                    self.shard_stats, group, groupby_cols
+                )
+                if strategy == planmod.STRATEGY_AUTO:
+                    strategy = None
+                else:
+                    self.counters["plan_strategy_hints"] += 1
+            # multi-query batching: identical pending work is joined, not
+            # re-dispatched.  The deadline is part of the identity: fusing
+            # across deadlines would let one client's budget expire (or
+            # never enforce) another client's work.  So is affinity: fusing
+            # across pins would silently run a pinned query elsewhere
+            work_key = (
+                tuple(group), plan_sig, sole, msg.get("deadline"), affinity,
+            )
+            existing = self._work_index.get(work_key)
+            if existing is not None and existing in self._work_subscribers:
+                self._work_subscribers[existing].append(parent_token)
+                self.counters["plan_shared_dispatches"] += 1
+                continue
+
             shard = CalcMessage({"payload": "groupby"})
             if sole:
                 shard["sole_shard"] = True
-            target = group if len(group) > 1 else group[0]
             shard.set_args_kwargs(
                 [target, groupby_cols, agg_list, where_terms],
                 {
@@ -911,6 +1297,15 @@ class ControllerNode:
             shard["parent_token"] = parent_token
             shard["filename"] = target
             shard["affinity"] = affinity
+            if msg.get("deadline") is not None:
+                shard["deadline"] = msg["deadline"]
+            shard.add_as_binary(
+                "plan",
+                planmod.fragment_for(
+                    plan, group, strategy=strategy, sole=sole
+                ),
+            )
+            self._register_work(shard, [parent_token], work_key=work_key)
             self.worker_out_messages.setdefault(affinity, []).append(shard)
 
     def _shard_groups(self, filenames, groupby_cols, agg_list, kwargs):

@@ -77,6 +77,30 @@ class Message(dict):
             buf = buf.encode("ascii")
         return pickle.loads(base64.b64decode(buf))
 
+    # -- deadlines ---------------------------------------------------------
+    # A deadline is an absolute unix timestamp under the ``deadline`` key.
+    # The RPC client stamps it, the controller copies it onto every shard
+    # CalcMessage it fans out (and expires queued work past it), and the
+    # worker refuses work that arrives already expired — replies keep the
+    # field (Message.copy()), so deadlines propagate end to end.
+    def set_deadline(self, seconds=None, at=None):
+        """Absolute (``at``) or relative-to-now (``seconds``) deadline."""
+        if at is not None:
+            self["deadline"] = float(at)
+        elif seconds is not None:
+            self["deadline"] = time.time() + float(seconds)
+
+    def deadline_remaining(self, now=None):
+        """Seconds until the deadline, or None when none is set."""
+        deadline = self.get("deadline")
+        if deadline is None:
+            return None
+        return float(deadline) - (time.time() if now is None else now)
+
+    def deadline_expired(self, now=None):
+        remaining = self.deadline_remaining(now)
+        return remaining is not None and remaining <= 0
+
     # -- call params -------------------------------------------------------
     def set_args_kwargs(self, args, kwargs):
         self.add_as_binary("params", {"args": args, "kwargs": kwargs})
@@ -94,6 +118,13 @@ class WorkerRegisterMessage(Message):
 
 
 class CalcMessage(Message):
+    """A unit of work for a calc worker.  Beyond the reference fields it may
+    carry ``deadline`` (absolute ts, see the deadline helpers above) and
+    ``plan`` — a pickled plan fragment (:func:`bqueryd_tpu.plan.fragment_for`)
+    holding the rewritten query, pushed-down predicates, and the planner's
+    kernel-strategy hint; workers execute the fragment when present and fall
+    back to the positional params otherwise (mixed-version clusters)."""
+
     msg_type = "calc"
 
 

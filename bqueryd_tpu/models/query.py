@@ -69,6 +69,24 @@ def extremum_fill(dtype, kind):
     return info.max if kind == "min" else info.min
 
 
+def normalize_agg_list(agg_list):
+    """Agg shorthand normalization: ``"col"`` -> ``[col, 'sum', col]``;
+    2-item ``[in, op]`` -> ``[in, op, in]``.  The ONE copy of these rules —
+    the worker's :class:`GroupByQuery` and the controller's logical plan
+    both use it, so the plan signature (the shared-dispatch fusion key) and
+    the executed query can never normalize differently."""
+    normalized = []
+    for agg in agg_list:
+        if isinstance(agg, str):
+            normalized.append([agg, "sum", agg])
+        elif len(agg) == 2:
+            agg = list(agg)
+            normalized.append([agg[0], agg[1], agg[0]])
+        else:
+            normalized.append(list(agg))
+    return normalized
+
+
 def freeze_value(value):
     """Canonical, hashable, collision-free form of a query parameter
     (repr() is ambiguous for numpy arrays, which truncate their repr)."""
@@ -111,15 +129,7 @@ class GroupByQuery:
         )
 
     def __post_init__(self):
-        normalized = []
-        for agg in self.agg_list:
-            if isinstance(agg, str):
-                normalized.append([agg, "sum", agg])
-            elif len(agg) == 2:
-                normalized.append([agg[0], agg[1], agg[0]])
-            else:
-                normalized.append(list(agg))
-        self.agg_list = normalized
+        self.agg_list = normalize_agg_list(self.agg_list)
 
     @property
     def in_cols(self):
@@ -539,7 +549,14 @@ class QueryEngine:
         return codes, uniques
 
     # -- execution ---------------------------------------------------------
-    def execute_local(self, table, query: GroupByQuery) -> ResultPayload:
+    def execute_local(self, table, query: GroupByQuery,
+                      strategy=None) -> ResultPayload:
+        """``strategy`` is the planner's kernel-route hint: ``"host"`` forces
+        the NumPy kernels (bypassing the latency threshold), ``"scatter"`` /
+        ``"sort"`` / ``"matmul"`` flow into :func:`ops.partial_tables` (the
+        matmul hint stays advisory there); None/"auto" keeps the adaptive
+        default.  A wedged backend overrides every device hint — survival
+        beats planning."""
         from bqueryd_tpu import ops
 
         if query.aggregate:
@@ -666,14 +683,15 @@ class QueryEngine:
                     else None
                     for _, a in mergeable
                 )
-                if len(dense) <= host_kernel_rows(
+                if strategy == "host" or len(dense) <= host_kernel_rows(
                     _host_ns_estimate(
                         table, [a for _, a in mergeable], len(dense)
                     )
                 ):
                     # latency-aware routing: below the threshold the host
                     # beats the device's dispatch+fetch floor (see
-                    # host_kernel_rows); identical partial semantics
+                    # host_kernel_rows); identical partial semantics.  The
+                    # planner's "host" hint forces this branch outright.
                     partials = ops.host_partial_tables(
                         dense.astype(np.int32), measures, mops, n_groups,
                         mask_arr, null_sentinels=sentinels,
@@ -689,6 +707,11 @@ class QueryEngine:
                         ops.partial_tables(
                             dense.astype(np.int32), measures, mops, n_prog,
                             mask_arr, null_sentinels=sentinels,
+                            strategy=(
+                                strategy
+                                if strategy in ("matmul", "scatter", "sort")
+                                else None
+                            ),
                         )
                     )
                     if n_prog != n_groups:

@@ -124,6 +124,12 @@ _SUM_BLOCK = 65536
 _MAX_BLOCK_SEGMENTS = 1 << 25
 
 
+#: kernel routes partial_tables accepts as a planner hint (None == "auto").
+#: "matmul" is advisory — every profitability/backend guard still applies —
+#: while "scatter"/"sort" are binding (both are always-correct fallbacks).
+KERNEL_STRATEGIES = ("auto", "matmul", "scatter", "sort")
+
+
 def _sorted_segment_sum(values, safe, n_groups, acc_dtype=jnp.int64):
     """Per-group sums without a wide scatter: sort rows by group code,
     prefix-sum the sorted values in ``acc_dtype``, and difference the prefix
@@ -147,7 +153,7 @@ def _sorted_segment_sum(values, safe, n_groups, acc_dtype=jnp.int64):
     return jnp.diff(jnp.concatenate([zero, bounds]))
 
 
-def _int64_segment_sum(values, valid, safe, n_groups):
+def _int64_segment_sum(values, valid, safe, n_groups, force_sort=False):
     """Exact per-group int64 sums of integer ``values`` without any int64
     scatter.
 
@@ -167,7 +173,7 @@ def _int64_segment_sum(values, valid, safe, n_groups):
     nbits = values.dtype.itemsize * 8
     signed_in = jnp.issubdtype(values.dtype, jnp.signedinteger)
     n_blocks = -(-n // _SUM_BLOCK)
-    if n_blocks * n_groups > _MAX_BLOCK_SEGMENTS:
+    if force_sort or n_blocks * n_groups > _MAX_BLOCK_SEGMENTS:
         return _sorted_segment_sum(v, safe, n_groups)
     # limbs: (int32 row, shift, signed). Non-top limbs are unsigned 16-bit
     # slices; the top limb carries the sign for signed inputs.
@@ -323,7 +329,7 @@ def _hicard_matmul_profitable(measures, ops, n, n_groups):
 
 
 def partial_tables(codes, measures, ops, n_groups, mask=None,
-                   null_sentinels=None):
+                   null_sentinels=None, strategy=None):
     """Compute per-group partial tables for one shard.
 
     codes:    int[n] dense group codes in [0, n_groups); negative = null key
@@ -344,6 +350,14 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
     Sums and counts route to the MXU one-hot matmul (module docstring) when
     the cardinality is within :func:`matmul_groups_limit`; min/max, float64
     measures, and high-cardinality queries use segment scatters.
+
+    ``strategy`` is the planner's route hint (:data:`KERNEL_STRATEGIES`):
+    ``"scatter"`` goes straight to the blocked scatters, ``"sort"`` to the
+    scatter entry with the sort+prefix-diff reduction forced, and
+    ``"matmul"``/``"auto"``/None keep the full profitability logic — the
+    hint can steer toward the MXU but never override its backend guard (a
+    CPU backend still declines, so a planner hint cannot reproduce the
+    forced-matmul regression).
     """
     ops = tuple(ops)
     measures = tuple(measures)
@@ -357,6 +371,18 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
             raise ValueError(
                 f"op {op!r} cannot aggregate a sentinel-null measure"
             )
+    if strategy is not None and strategy not in KERNEL_STRATEGIES:
+        raise ValueError(f"unknown kernel strategy {strategy!r}")
+    if strategy == "scatter":
+        return _partial_tables_scatter(
+            codes, measures, ops, int(n_groups), mask,
+            null_sentinels=null_sentinels,
+        )
+    if strategy == "sort":
+        return _partial_tables_scatter(
+            codes, measures, ops, int(n_groups), mask,
+            null_sentinels=null_sentinels, force_sort=True,
+        )
     if _matmul_profitable(measures, ops, int(codes.shape[0]), int(n_groups)):
         # env flags are read HERE, outside jit, so toggling them takes effect
         # per call instead of being frozen into the first trace
@@ -681,11 +707,15 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_groups", "ops", "null_sentinels")
+    jax.jit,
+    static_argnames=("n_groups", "ops", "null_sentinels", "force_sort"),
 )
 def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
-                            null_sentinels=None):
-    """Scatter path: blocked-int32 segment sums (exact, no s64 scatter)."""
+                            null_sentinels=None, force_sort=False):
+    """Scatter path: blocked-int32 segment sums (exact, no s64 scatter).
+    ``force_sort`` (the planner's "sort" strategy) makes every sum take the
+    sort+prefix-diff reduction regardless of the blocks x groups budget —
+    identical partial semantics, group-count-independent cost."""
     valid = codes >= 0
     if mask is not None:
         valid = valid & mask
@@ -696,7 +726,10 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
     )
 
     def int_count(flags):  # bool[n] -> int64[n_groups], no s64 scatter
-        return _int64_segment_sum(flags.astype(jnp.int8), flags, safe, n_groups)
+        return _int64_segment_sum(
+            flags.astype(jnp.int8), flags, safe, n_groups,
+            force_sort=force_sort,
+        )
 
     rows = int_count(valid)
 
@@ -727,9 +760,13 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
                     values.dtype if floating else jnp.float64
                 )
                 contrib = jnp.where(present, values, 0).astype(acc)
-                if (
-                    contrib.dtype == jnp.float64
-                    and jax.default_backend() != "cpu"
+                # the sort+prefix-diff reduction differences near-equal
+                # large prefixes, so it requires the float64 accumulator
+                # (the x64 default here); a float32 accumulator (x64 off)
+                # stays on the scatter even under a binding "sort" hint —
+                # catastrophic cancellation is worse than the hint miss
+                if contrib.dtype == jnp.float64 and (
+                    force_sort or jax.default_backend() != "cpu"
                 ):
                     # no native f64 on TPU: an emulated-f64 scatter is the
                     # wide-scatter cost this module exists to avoid; the
@@ -738,14 +775,18 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
                     # flow)
                     partial = {
                         "sum": _sorted_segment_sum(
-                            contrib, safe, n_groups, acc_dtype=jnp.float64
+                            contrib, safe, n_groups,
+                            acc_dtype=contrib.dtype,
                         )
                     }
                 else:
                     partial = {"sum": seg_sum(contrib)}
             else:
                 partial = {
-                    "sum": _int64_segment_sum(values, present, safe, n_groups)
+                    "sum": _int64_segment_sum(
+                        values, present, safe, n_groups,
+                        force_sort=force_sort,
+                    )
                 }
             if op == "mean":
                 partial["count"] = present_count()
@@ -1136,9 +1177,17 @@ def expand_mask_by_group(group_codes, mask, n_groups=None):
             n_groups = codes_np.shape[0]
         valid = codes_np >= 0
         hit = np.zeros(max(int(n_groups), 1), dtype=bool)
-        sel = valid & mask_np
+        # out-of-range codes (>= n_groups) mirror the device twin exactly:
+        # the jit scatter (segment_max with num_segments) silently DROPS
+        # them, and the jit gather CLAMPS the index — an unguarded numpy
+        # fancy-index would instead raise IndexError (divergent edge
+        # semantics between two interchangeable paths, ADVICE r5 low #2)
+        sel = valid & mask_np & (codes_np < int(n_groups))
         hit[codes_np[sel]] = True
-        return valid & hit[np.where(valid, codes_np, 0)]
+        gather = np.minimum(
+            np.where(valid, codes_np, 0), max(int(n_groups) - 1, 0)
+        )
+        return valid & hit[gather]
     group_codes = jnp.asarray(group_codes)
     if n_groups is None:
         n_groups = group_codes.shape[0]

@@ -51,6 +51,16 @@ def pallas_enabled():
     return os.environ.get("BQUERYD_TPU_PALLAS", "0") == "1"
 
 
+def _enable_x64(flag):
+    """Version-portable x64-mode context: ``jax.enable_x64`` (jax >= 0.5)
+    with a fallback to its pre-0.5 ``jax.experimental`` home."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(flag)
+    from jax.experimental import enable_x64 as legacy_enable_x64
+
+    return legacy_enable_x64(flag)
+
+
 def _round_up(x, mult):
     return -(-x // mult) * mult
 
@@ -298,7 +308,7 @@ def onehot_rows_dot_hicard(codes, rows, n_rows, n_groups, interpret=False):
     )
     nb = npad // BLOCK_K
     ngt = gpad // gt
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = pl.pallas_call(
             _make_hicard_kernel(kt, gt),
             out_shape=jax.ShapeDtypeStruct((rpad, gpad), jnp.int32),
@@ -354,5 +364,5 @@ def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
     rows_p = jnp.pad(
         rows.astype(jnp.bfloat16), ((0, rpad - n_rows), (0, npad - n))
     )
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _call(codes_p, rows_p, rpad, gpad, interpret)

@@ -71,6 +71,20 @@ def term_mask(values, op, value):
 
     if devicehealth.backend_wedged():
         import numpy as xp
+
+        if not isinstance(values, xp.ndarray) and type(values).__module__.split(
+            ".", 1
+        )[0].startswith("jax"):
+            # a device-resident jax Array here means the latch flipped AFTER
+            # columns were device-put: np.asarray on it would perform the
+            # blocking device transfer this branch exists to avoid.  Fail
+            # fast instead of hanging the worker loop; the caller's wedged
+            # routing retries from host-resident columns.
+            raise TypeError(
+                "term_mask received a device-resident array while the "
+                "accelerator backend is wedged; re-evaluate the filter from "
+                "host-resident columns"
+            )
     else:
         import jax.numpy as xp
     values = xp.asarray(values)

@@ -38,6 +38,7 @@ and H2D entirely and costs one compiled kernel dispatch.
 
 import functools
 import os
+import threading
 import time
 
 import numpy as np
@@ -459,8 +460,18 @@ class MeshQueryExecutor:
         return out.reshape(n_devices, width)
 
     # -- execution ----------------------------------------------------------
-    def execute(self, tables, query: GroupByQuery) -> ResultPayload:
+    def execute(self, tables, query: GroupByQuery,
+                strategy=None) -> ResultPayload:
+        """``strategy`` is the planner's kernel-route hint, threaded into the
+        mesh program's ``partial_tables`` call (and its trace cache key);
+        None/"auto" keeps the dispatcher's own adaptive choice."""
         from bqueryd_tpu import ops
+
+        if strategy in (None, "auto", "host"):
+            # "host" is meaningless inside a mesh program; the worker should
+            # not have routed such a query here, but degrade to auto rather
+            # than refuse
+            strategy = None
 
         if not self.supports(query):
             raise ValueError(
@@ -632,6 +643,7 @@ class MeshQueryExecutor:
                         mesh, self.axis_name, query.ops, n_prog,
                         codes_d, tuple(measures_d),
                         null_sentinels=sentinels,
+                        strategy=strategy,
                     )
                     break
                 except jax.errors.JaxRuntimeError as exc:
@@ -746,9 +758,28 @@ def _route_key():
     )
 
 
+def _shard_map(fn, mesh, in_specs, out_specs, check):
+    """Version-portable shard_map: ``jax.shard_map`` (its home since jax
+    0.6, ``check_vma=``) with a fallback to the pre-0.6
+    ``jax.experimental.shard_map`` location (``check_rep=``)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    return legacy_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
-                  null_sentinels=None, route=None):
+                  null_sentinels=None, route=None, strategy=None):
     """Build + cache the jitted shard_map program for one query shape.
 
     The key carries everything that can change the traced program — measure
@@ -771,6 +802,7 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
             agg_ops,
             n_groups,
             null_sentinels=null_sentinels,
+            strategy=strategy,
         )
         merged = ops.psum_partials(partials, axis)
         if not pack:
@@ -784,15 +816,15 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
 
         return jnp.concatenate([_pack_leaf(leaf).ravel() for leaf in leaves])
 
-    fn = jax.shard_map(
+    # pallas_call outputs carry no varying-mesh-axes metadata, so the vma/rep
+    # check would reject the kernel path; the psum in block_fn is what makes
+    # the out_specs=P() replication true by construction
+    fn = _shard_map(
         block_fn,
         mesh=mesh,
         in_specs=tuple([P(axis, None)] * len(in_dtypes)),
         out_specs=P(),
-        # pallas_call outputs carry no varying-mesh-axes metadata, so the vma
-        # check would reject the kernel path; the psum in block_fn is what
-        # makes the out_specs=P() replication true by construction
-        check_vma=False,
+        check=False,
     )
     return jax.jit(fn), spec
 
@@ -828,14 +860,63 @@ def _transient_status(exc):
     return any(s in msg for s in _TRANSIENT_STATUSES)
 
 
+def _effective_mesh_strategy(strategy, agg_ops, n_groups, measures_d, width):
+    """Canonicalize a planner hint for the mesh-program cache key: a hint
+    that cannot change the traced route must key (and trace) exactly like
+    ``auto``, or an identical program would be compiled twice — a "matmul"
+    hint is advisory by definition (the dispatcher decides identically under
+    auto), and a "scatter" hint is a no-op whenever auto would scatter
+    anyway (always on CPU backends, and past the matmul group ceiling)."""
+    if strategy in (None, "auto", "matmul"):
+        return None
+    from bqueryd_tpu.ops import groupby as gb
+
+    mm = gb._matmul_profitable(
+        measures_d, agg_ops, width, int(n_groups)
+    ) or gb._hicard_matmul_profitable(
+        measures_d, agg_ops, width, int(n_groups)
+    )
+    if strategy == "scatter" and not mm:
+        return None
+    if strategy == "sort" and not mm:
+        # auto's scatter entry already sorts past the blocks x groups budget
+        blocks = -(-width // gb._SUM_BLOCK)
+        if blocks * int(n_groups) > gb._MAX_BLOCK_SEGMENTS:
+            return None
+    return strategy
+
+
+#: serializes mesh-program execution on CPU backends: XLA:CPU cross-module
+#: collectives rendezvous by participant count process-globally, so two
+#: concurrent psum programs from different threads (an in-process multi-
+#: worker test cluster) interleave their AllReduce participants and
+#: deadlock.  Production topology is one process per device set, where the
+#: lock is uncontended; TPU backends skip it entirely.
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _collective_guard():
+    import contextlib
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return _CPU_COLLECTIVE_LOCK
+    return contextlib.nullcontext()
+
+
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
-                   null_sentinels=None):
+                   null_sentinels=None, strategy=None):
     """Run the mesh program and return the merged partials pytree ON HOST
     (numpy leaves) — fetching one packed buffer when packing is enabled."""
     global _packed_fetch_broken
     import jax
 
     pack = packed_fetch_enabled() and not _packed_fetch_broken
+    strategy = _effective_mesh_strategy(
+        strategy, tuple(agg_ops), n_groups, measures_d,
+        int(codes_d.shape[1]),
+    )
     in_dtypes = (str(codes_d.dtype),) + tuple(str(m.dtype) for m in measures_d)
 
     def run(pack_flag):
@@ -844,6 +925,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             int(codes_d.shape[1]), pack_flag,
             null_sentinels,  # part of the lru key: it changes the trace
             route=_route_key(),  # ditto: the flags steer the traced route
+            strategy=strategy,  # planner hint: a different traced route too
         )
 
     global _packed_transient_count
@@ -851,8 +933,9 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
     if pack:
         try:
             program, spec = run(True)
-            out = program(codes_d, *measures_d)
-            flat = np.asarray(jax.device_get(out))
+            with _collective_guard():
+                out = program(codes_d, *measures_d)
+                flat = np.asarray(jax.device_get(out))
         except Exception as exc:
             if (
                 isinstance(exc, jax.errors.JaxRuntimeError)
@@ -889,7 +972,8 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             leaves = _unpack_host(flat, spec["leaves"])
             return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
     program, _spec = run(False)
-    result = jax.device_get(program(codes_d, *measures_d))
+    with _collective_guard():
+        result = jax.device_get(program(codes_d, *measures_d))
     if latch_pending:
         _packed_fetch_broken = True
         _packed_transient_count = 0

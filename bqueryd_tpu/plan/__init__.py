@@ -1,0 +1,55 @@
+"""Query planning & admission: the controller's serving-layer brain.
+
+Four pieces, all control-plane safe (no JAX, no pandas):
+
+* :mod:`bqueryd_tpu.plan.logical`   — typed logical plans compiled from the
+  ``groupby`` RPC, with rewrite rules (predicate pushdown, mean
+  decomposition) and per-dispatch plan fragments;
+* :mod:`bqueryd_tpu.plan.stats`     — per-shard statistics (rows, column
+  min/max, key cardinality) gathered by workers, advertised in their
+  registration messages, and the stats-only shard pruning predicate;
+* :mod:`bqueryd_tpu.plan.strategy`  — cost-based kernel-route selection
+  (scatter vs sort+prefix-diff vs MXU limb-matmul) from those stats;
+* :mod:`bqueryd_tpu.plan.admission` — bounded priority admission queue with
+  per-client quotas, deadlines, and explicit BUSY backpressure.
+
+``BQUERYD_TPU_PLANNER=0`` disables plan-time pruning and strategy hints
+(queries revert to the static fan-out); admission limits are controlled by
+their own env knobs (see :mod:`.admission`).
+"""
+
+import os
+
+from bqueryd_tpu.plan.admission import (  # noqa: F401
+    ADMIT,
+    BUSY,
+    DUPLICATE,
+    QUEUED,
+    AdmissionController,
+)
+from bqueryd_tpu.plan.logical import (  # noqa: F401
+    LogicalPlan,
+    compile_groupby,
+    fragment_for,
+    fragment_to_query,
+    plan_groupby,
+    rewrite_plan,
+)
+from bqueryd_tpu.plan.stats import (  # noqa: F401
+    StatsCollector,
+    gather_table_stats,
+    stats_can_match,
+)
+from bqueryd_tpu.plan.strategy import (  # noqa: F401
+    STRATEGIES,
+    STRATEGY_AUTO,
+    choose_strategy,
+    estimate_groups,
+    select_for_group,
+)
+
+
+def planner_enabled():
+    """Plan-time pruning + strategy hints; on unless BQUERYD_TPU_PLANNER=0.
+    Read per query so a live controller can be re-tuned."""
+    return os.environ.get("BQUERYD_TPU_PLANNER", "1") != "0"

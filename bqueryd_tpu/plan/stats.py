@@ -1,0 +1,207 @@
+"""Per-shard statistics: gathered by calc workers, advertised in their
+WorkerRegisterMessage, consumed by the controller's planner.
+
+A shard's stats are metadata-only reads — nothing is decompressed:
+
+* ``rows`` from the table's meta.json;
+* per-column ``min``/``max`` from the chunk-writer stats in each column's
+  meta (:meth:`ctable.col_stats`), datetime columns in int64 ns;
+* per-column key ``card``inality from whichever cheap source exists:
+  a dict column's dictionary length, or the on-disk factorize sidecar
+  (``factor.npz``) written by a previous query — the ``uniques`` member is
+  read without touching the (much larger) codes array.
+
+``stats_can_match`` is the controller-side twin of
+:func:`bqueryd_tpu.ops.predicates.shard_can_match`: it decides from
+advertised stats alone whether a shard can contain ANY row matching a
+filter conjunction, so provably-empty shards are pruned at plan time and
+never dispatched.  It only prunes on plain numeric comparisons (the
+controller has no pandas for datetime translation and no dictionaries for
+dict-code translation); anything else conservatively matches — the worker's
+own ``shard_can_match`` remains the second, stronger pruning line.
+
+Control-plane module: no JAX, no pandas.
+"""
+
+import os
+
+import numpy as np
+
+#: numbers the controller can compare against min/max stats without any
+#: column-kind translation (bool excluded on purpose: bool storage has no
+#: stats anyway)
+_NUMBER = (int, float)
+
+
+def _sidecar_cardinality(table, name):
+    """len(uniques) from the column's factorize sidecar, or None.  Loads only
+    the stamp + uniques members of the npz — never the row-length codes."""
+    path = table._col_path(name, "factor.npz")
+    stamp = table.factor_stamp(name)
+    if stamp is None or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if not np.array_equal(z["stamp"], stamp):
+                return None
+            return int(z["uniques"].shape[0])
+    except Exception:
+        return None
+
+
+def column_cardinality(table, name):
+    """Best-known distinct-value count for a column, or None (unknown)."""
+    if table.kind(name) == "dict":
+        dictionary = table.dictionary(name)
+        return None if dictionary is None else len(dictionary)
+    return _sidecar_cardinality(table, name)
+
+
+def gather_table_stats(table):
+    """One shard's advertised stats (JSON-safe dict)."""
+    cols = {}
+    for name in table.names:
+        entry = {"kind": table.kind(name)}
+        stats = table.col_stats(name)
+        if stats is not None:
+            entry["min"], entry["max"] = stats
+        card = column_cardinality(table, name)
+        if card is not None:
+            entry["card"] = card
+        cols[name] = entry
+    return {"rows": int(table.nrows), "cols": cols}
+
+
+class StatsCollector:
+    """Memoized per-shard stats for a worker's data dir.
+
+    Called from both the worker's main loop and its liveness heartbeat
+    thread, so gathering must stay cheap: full stats are memoized per shard
+    and re-gathered only when the shard's meta identity or its factorize
+    sidecars change (a query writing a new sidecar refreshes the advertised
+    cardinality on the next heartbeat)."""
+
+    #: min seconds between full stamp sweeps: inside the window collect()
+    #: returns the previous snapshot OBJECT without touching the filesystem,
+    #: so per-heartbeat cost is O(1) however many shards/columns exist (the
+    #: identity also lets the WRM builder skip re-advertising unchanged
+    #: stats, see WorkerBase.prepare_wrm)
+    MIN_REFRESH_S = 5.0
+
+    def __init__(self, table_opener=None, min_refresh_s=None):
+        self._open = table_opener
+        self._memo = {}  # shard name -> (stamp, stats dict)
+        self.min_refresh_s = (
+            self.MIN_REFRESH_S if min_refresh_s is None else min_refresh_s
+        )
+        self._snapshot = None
+        self._snapshot_names = None
+        self._snapshot_ts = 0.0
+
+    def _stamp(self, rootdir, table):
+        """Identity of everything the stats derive from: the table meta plus
+        every column's factor sidecar mtime (present or absent)."""
+        from bqueryd_tpu.storage.ctable import rootdir_cache_key
+
+        parts = [rootdir_cache_key(rootdir)]
+        for name in table.names:
+            try:
+                st = os.stat(table._col_path(name, "factor.npz"))
+                parts.append((name, st.st_mtime_ns, st.st_size))
+            except OSError:
+                parts.append((name, None))
+        return tuple(parts)
+
+    def collect(self, data_dir, names):
+        """{shard name: stats} for every shard that opens cleanly.  Returns
+        the SAME dict object until the refresh window elapses or the shard
+        list changes — callers may use identity to detect staleness."""
+        import time
+
+        now = time.time()
+        if (
+            self._snapshot is not None
+            and now - self._snapshot_ts < self.min_refresh_s
+            and self._snapshot_names == tuple(names)
+        ):
+            return self._snapshot
+        out = {}
+        for name in names:
+            rootdir = os.path.join(data_dir, name)
+            try:
+                table = (
+                    self._open(rootdir)
+                    if self._open is not None
+                    else _default_open(rootdir)
+                )
+                stamp = self._stamp(rootdir, table)
+                hit = self._memo.get(name)
+                if hit is not None and hit[0] == stamp:
+                    out[name] = hit[1]
+                    continue
+                stats = gather_table_stats(table)
+                self._memo[name] = (stamp, stats)
+                out[name] = stats
+            except Exception:
+                continue  # an unreadable shard simply advertises no stats
+        for gone in set(self._memo) - set(names):
+            self._memo.pop(gone, None)
+        # keep the previous snapshot OBJECT when nothing changed, so the
+        # WRM builder's identity check keeps suppressing re-advertisement
+        if self._snapshot is not None and out == self._snapshot:
+            out = self._snapshot
+        self._snapshot = out
+        self._snapshot_names = tuple(names)
+        self._snapshot_ts = now
+        return out
+
+
+def _default_open(rootdir):
+    from bqueryd_tpu.storage.ctable import ctable
+
+    return ctable(rootdir, mode="r", auto_cache=True)
+
+
+def stats_can_match(stats, where_terms):
+    """False only if NO row of the shard can satisfy the conjunction, judged
+    from advertised stats alone.  Mirrors ``ops.predicates.shard_can_match``
+    restricted to plain numeric comparisons; unknown columns, kinds, ops or
+    value types conservatively match."""
+    cols = stats.get("cols") if isinstance(stats, dict) else None
+    if not isinstance(cols, dict):
+        cols = {}
+    for term in where_terms or []:
+        try:
+            column, op, value = term
+        except (TypeError, ValueError):
+            continue
+        entry = cols.get(column)
+        if not isinstance(entry, dict) or entry.get("kind") != "numeric":
+            continue
+        lo, hi = entry.get("min"), entry.get("max")
+        # advertised bounds must themselves be numbers: garbage stats must
+        # read as "cannot prune", never raise mid-launch
+        if not isinstance(lo, _NUMBER) or not isinstance(hi, _NUMBER):
+            continue
+        if op == "in":
+            if (
+                isinstance(value, (list, tuple, set, frozenset))
+                and value
+                and all(isinstance(v, _NUMBER) for v in value)
+                and all(v < lo or v > hi for v in value)
+            ):
+                return False
+            continue
+        if not isinstance(value, _NUMBER) or isinstance(value, bool):
+            continue
+        if op == "==" and (value < lo or value > hi):
+            return False
+        if op == ">" and hi <= value:
+            return False
+        if op == ">=" and hi < value:
+            return False
+        if op == "<" and lo >= value:
+            return False
+        if op == "<=" and lo > value:
+            return False
+    return True

@@ -1,0 +1,144 @@
+"""Cost-based kernel-strategy selection from per-shard statistics.
+
+``ops.groupby.partial_tables`` has three physical routes for the mergeable
+aggregations:
+
+* ``matmul``  — the MXU one-hot limb-matmul (rides the systolic array; wins
+  up to ``BQUERYD_TPU_MATMUL_GROUPS`` groups, loses badly when emulated on a
+  CPU backend — the 6x regression BENCH_r05 measured for the forced route);
+* ``scatter`` — blocked exact-int32 segment scatters (the default past the
+  matmul group ceiling);
+* ``sort``    — sort + prefix-diff reduction, whose cost is independent of
+  the group count (takes over when the scatter's ``blocks x groups`` table
+  outgrows HBM economics).
+
+Until this subsystem the route was chosen at kernel-dispatch time from the
+ACTUAL factorized cardinality — correct, but only after every shard was
+dispatched and decoded.  The planner chooses per dispatch from advertised
+stats instead, and the hint travels in the plan fragment.
+
+The hint is ADVISORY by design: ``partial_tables`` keeps every safety guard.
+In particular a ``matmul`` hint still passes through ``_matmul_profitable``,
+whose CPU-emulation guard stands — the planner path can never reproduce the
+forced-matmul regression, because forcing is exactly what a hint cannot do.
+With no stats (cold shard, no sidecar yet) the selector returns ``auto``:
+identical behaviour to the pre-planner static route.
+
+Group-cardinality estimation: per key column, shards whose [min, max] ranges
+overlap are assumed to share a key domain (their global cardinality is the
+max per-shard cardinality — the iid-sharding case); pairwise-disjoint ranges
+sum (range-partitioned data).  Multi-key spaces multiply per-column
+estimates, capped by the row count.
+
+Control-plane module: no JAX imports.  The two env knobs it reads mirror
+``ops.groupby`` (``BQUERYD_TPU_MATMUL_GROUPS``, ``BQUERYD_TPU_MATMUL_CELLS``)
+— duplicated here rather than imported because ``ops`` pulls in JAX.
+"""
+
+import os
+
+STRATEGY_AUTO = "auto"
+STRATEGY_HOST = "host"
+STRATEGY_MATMUL = "matmul"
+STRATEGY_SCATTER = "scatter"
+STRATEGY_SORT = "sort"
+
+STRATEGIES = (
+    STRATEGY_AUTO, STRATEGY_HOST, STRATEGY_MATMUL, STRATEGY_SCATTER,
+    STRATEGY_SORT,
+)
+
+#: mirrors ops.groupby._SUM_BLOCK / _MAX_BLOCK_SEGMENTS: the blocked scatter
+#: materializes ceil(rows / 65536) x groups buckets and stops paying for
+#: itself past 2^25 of them
+_SUM_BLOCK = 65536
+_MAX_BLOCK_SEGMENTS = 1 << 25
+
+
+def matmul_groups_limit():
+    """JAX-free mirror of ``ops.groupby.matmul_groups_limit``."""
+    return int(os.environ.get("BQUERYD_TPU_MATMUL_GROUPS", 8192))
+
+
+def matmul_cells_limit():
+    """JAX-free mirror of ``ops.groupby._matmul_cells_limit``."""
+    return int(os.environ.get("BQUERYD_TPU_MATMUL_CELLS", 1 << 36))
+
+
+def _column_card_estimate(stats_list, column):
+    """Estimated global distinct count of ``column`` across a shard group,
+    or None when any shard lacks the cardinality.  Overlapping value ranges
+    -> shared domain (max); disjoint ranges -> partitioned domain (sum)."""
+    cards, ranges = [], []
+    for stats in stats_list:
+        entry = ((stats or {}).get("cols") or {}).get(column)
+        if not entry or "card" not in entry:
+            return None
+        cards.append(int(entry["card"]))
+        if entry.get("min") is not None and entry.get("max") is not None:
+            ranges.append((entry["min"], entry["max"]))
+    if not cards:
+        return None
+    if len(ranges) == len(cards) and len(ranges) > 1:
+        ordered = sorted(ranges)
+        disjoint = all(
+            ordered[i][1] < ordered[i + 1][0] for i in range(len(ordered) - 1)
+        )
+        if disjoint:
+            return sum(cards)
+    return max(cards)
+
+
+def estimate_groups(stats_list, groupby_cols):
+    """Estimated group count of a query over a shard group, or None when the
+    stats cannot support an estimate (some shard or key column unknown)."""
+    if not stats_list or any(s is None for s in stats_list):
+        return None
+    total_rows = sum(int(s.get("rows", 0)) for s in stats_list)
+    est = 1
+    for col in groupby_cols:
+        card = _column_card_estimate(stats_list, col)
+        if card is None:
+            return None
+        est *= max(card, 1)
+        if est >= total_rows:
+            return max(total_rows, 1)  # cannot exceed the row count
+    return max(est, 1)
+
+
+def choose_strategy(total_rows, est_groups):
+    """Pick a kernel route from (rows, estimated groups); ``auto`` when the
+    estimate is missing or the economics are ambiguous."""
+    if est_groups is None or total_rows is None or total_rows <= 0:
+        return STRATEGY_AUTO
+    limit = matmul_groups_limit()
+    if 0 < est_groups <= limit and total_rows * est_groups <= matmul_cells_limit():
+        # low cardinality: the MXU one-hot contraction wins where available;
+        # partial_tables still applies its backend guard (advisory hint)
+        return STRATEGY_MATMUL
+    if est_groups > limit:
+        blocks = -(-total_rows // _SUM_BLOCK)
+        if blocks * est_groups > _MAX_BLOCK_SEGMENTS:
+            # the blocked scatter table would outgrow its HBM budget: the
+            # sort + prefix-diff reduction is group-count-independent
+            return STRATEGY_SORT
+        return STRATEGY_SCATTER
+    return STRATEGY_AUTO
+
+
+def select_for_group(stats_by_file, filenames, groupby_cols):
+    """Controller entry point: strategy hint for one dispatch group.
+    Returns ``(strategy, est_groups, total_rows)``.  Malformed advertised
+    stats (version-skewed worker) degrade to ``auto``, never raise — a
+    stats problem must not fail the query it was meant to speed up."""
+    stats_list = [
+        (stats_by_file or {}).get(f) for f in filenames
+    ]
+    if any(not isinstance(s, dict) for s in stats_list):
+        return STRATEGY_AUTO, None, None
+    try:
+        total_rows = sum(int(s.get("rows", 0)) for s in stats_list)
+        est = estimate_groups(stats_list, groupby_cols)
+        return choose_strategy(total_rows, est), est, total_rows
+    except (TypeError, ValueError):
+        return STRATEGY_AUTO, None, None

@@ -36,6 +36,11 @@ class RPCError(Exception):
     pass
 
 
+class RPCBusyError(RPCError):
+    """The controller's admission queue rejected the query (backpressure).
+    Deliberate and immediate — retry with backoff or shed load upstream."""
+
+
 class RPC:
     def __init__(
         self,
@@ -46,12 +51,17 @@ class RPC:
         loglevel=logging.INFO,
         retries=3,
         legacy_merge=False,
+        client_id=None,
     ):
         bqueryd_tpu.configure_logging(loglevel)
         self.logger = bqueryd_tpu.logger.getChild("rpc")
         self.timeout = timeout
         self.retries = retries
         self.legacy_merge = legacy_merge
+        # admission quota bucket: sockets sharing a client_id share the
+        # controller's per-client quota (BQUERYD_TPU_ADMIT_CLIENT_QUOTA);
+        # unset, each socket identity is its own bucket
+        self.client_id = client_id
         self.last_call_duration = None
         self.identity = os.urandom(8).hex()
         self.store = coordination_store(
@@ -115,7 +125,18 @@ class RPC:
             # the sum-of-shard-means quirk needs per-shard payloads: disable
             # the controller's batched (pre-merged) shard-group dispatch
             kwargs.setdefault("batch", False)
+        # serving-layer kwargs ride the ENVELOPE, not the call params: the
+        # controller reads them before any plan compilation, and the worker
+        # must never see them as query arguments
+        deadline = kwargs.pop("deadline", None)
+        priority = kwargs.pop("priority", None)
         msg = RPCMessage({"payload": name})
+        if deadline is not None:
+            msg.set_deadline(seconds=float(deadline))
+        if priority is not None:
+            msg["priority"] = priority
+        if self.client_id is not None:
+            msg["client_id"] = self.client_id
         msg.set_args_kwargs(list(args), kwargs)
         wire = msg.to_json().encode()
         reply = None
@@ -165,6 +186,8 @@ class RPC:
             raise RPCError(msg.get("payload"))
         envelope = pickle.loads(reply)
         if not envelope.get("ok"):
+            if envelope.get("busy"):
+                raise RPCBusyError(envelope.get("error"))
             raise RPCError(envelope.get("error"))
         payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
         self.last_call_timings = envelope.get("timings")

@@ -278,6 +278,36 @@ class WorkerBase:
         self.data_files = found
         return found
 
+    def shard_stats(self):
+        """Per-shard planning statistics advertised in the WRM (rows, column
+        min/max, key cardinalities); None for roles without tables.  The calc
+        role overrides."""
+        return None
+
+    #: re-advertise unchanged shard stats at most this often: WRMs fire every
+    #: heartbeat on two threads, and serializing O(shards x columns) stats
+    #: into each would make liveness cost scale with data size.  The
+    #: periodic re-send (rather than change-only) covers controller restarts,
+    #: which silently lose absorbed stats.
+    STATS_READVERTISE_S = 60.0
+
+    def _stats_to_advertise(self):
+        """Shard stats for this WRM, or None when the receiver already has
+        them (same snapshot object advertised within the re-send window)."""
+        stats = self.shard_stats()
+        if stats is None:
+            return None
+        now = time.time()
+        if (
+            stats is getattr(self, "_stats_sent_obj", None)
+            and now - getattr(self, "_stats_sent_ts", 0.0)
+            < self.STATS_READVERTISE_S
+        ):
+            return None
+        self._stats_sent_obj = stats
+        self._stats_sent_ts = now
+        return stats
+
     def prepare_wrm(self):
         return WorkerRegisterMessage(
             {
@@ -301,6 +331,11 @@ class WorkerBase:
                 "backend_wedged": devicehealth.backend_wedged(
                     launch=self.workertype == "calc"
                 ),
+                # metadata-only per-shard stats (rows, min/max, cardinality)
+                # feeding the controller's plan-time pruning and kernel-
+                # strategy selection; None for non-calc roles and for beats
+                # where the unchanged stats were advertised recently
+                "shard_stats": self._stats_to_advertise(),
             }
         )
 
@@ -380,6 +415,13 @@ class WorkerBase:
         busy = BusyMessage({"worker_id": self.worker_id})
         self.send_to_all(busy)
         try:
+            if msg.deadline_expired():
+                # the client's budget is already gone: burning kernel time on
+                # an answer nobody is waiting for starves admitted queries
+                raise TimeoutError(
+                    f"deadline exceeded "
+                    f"{-msg.deadline_remaining():.3f}s before execution"
+                )
             result = self.handle_work(msg)
         except Exception:
             self.logger.exception("error handling work")
@@ -498,6 +540,7 @@ class WorkerNode(WorkerBase):
         self._mesh_executor = None
         self._result_cache = None
         self._table_cache = {}
+        self._stats_collector = None
         self._warmup_thread = None
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
@@ -548,6 +591,31 @@ class WorkerNode(WorkerBase):
         except Exception:
             self.logger.exception("kernel warmup failed (continuing)")
 
+    def shard_stats(self):
+        """Metadata-only stats for every advertised shard (memoized; see
+        plan.stats.StatsCollector).  Disable with BQUERYD_TPU_SHARD_STATS=0
+        — the planner then treats this worker's shards as stats-less (no
+        pruning, auto strategy)."""
+        if os.environ.get("BQUERYD_TPU_SHARD_STATS", "1") == "0":
+            return None
+        # getattr defences: embedders (and tests) build workers piecemeal,
+        # and a stats failure must never break the WRM heartbeat
+        try:
+            collector = getattr(self, "_stats_collector", None)
+            if collector is None:
+                from bqueryd_tpu.plan.stats import StatsCollector
+
+                collector = StatsCollector(table_opener=self._open_table)
+                self._stats_collector = collector
+            return collector.collect(
+                self.data_dir, list(self.data_files)
+            )
+        except Exception:
+            log = getattr(self, "logger", None)
+            if log is not None:
+                log.debug("shard stats gathering failed", exc_info=True)
+            return None
+
     @property
     def engine(self):
         if self._engine is None:
@@ -589,12 +657,18 @@ class WorkerNode(WorkerBase):
         # explicit False check: an EMPTY BytesCappedCache is len()-falsy
         return None if self._result_cache is False else self._result_cache
 
-    def _execute(self, tables, query, timer):
+    def _execute(self, tables, query, timer, strategy=None):
         """Psum-mergeable aggregations (any shard count) -> mesh executor
         (on-device merge + HBM-resident caches); distinct-count / raw-rows
         single shard -> single-device engine; other multi-shard shapes ->
         per-shard engine + host value-keyed merge.  Always returns ONE
-        payload per CalcMessage."""
+        payload per CalcMessage.
+
+        ``strategy`` is the planner's kernel-route hint from the plan
+        fragment: "host" skips the mesh outright (the engine path forces the
+        NumPy kernels); device routes thread into the mesh program / engine
+        dispatch.  Hints never override survival routing — a wedged backend
+        still host-routes everything."""
         from bqueryd_tpu.models.query import (
             _host_ns_estimate,
             host_kernel_rows,
@@ -610,7 +684,8 @@ class WorkerNode(WorkerBase):
         # A wedged accelerator backend skips the mesh outright: the engine
         # path below host-routes everything (host_kernel_rows returns its
         # wedged sentinel) instead of hanging on a device dispatch.
-        if not devicehealth.backend_wedged() and MeshQueryExecutor.supports(
+        if strategy != "host" and not devicehealth.backend_wedged(
+        ) and MeshQueryExecutor.supports(
             query
         ) and total_rows > host_kernel_rows(
             max(
@@ -630,7 +705,9 @@ class WorkerNode(WorkerBase):
             import jax
 
             try:
-                return self.mesh_executor.execute(tables, query)
+                return self.mesh_executor.execute(
+                    tables, query, strategy=strategy
+                )
             except ops_mod.CompositeOverflow:
                 # the mesh alignment needs radix-packed composites; a key
                 # space past int64 degrades to the per-shard engine path,
@@ -653,9 +730,14 @@ class WorkerNode(WorkerBase):
                 )
         if len(tables) == 1:
             self.engine.timer = timer
-            return self.engine.execute_local(tables[0], query)
+            return self.engine.execute_local(
+                tables[0], query, strategy=strategy
+            )
         self.engine.timer = timer
-        payloads = [self.engine.execute_local(t, query) for t in tables]
+        payloads = [
+            self.engine.execute_local(t, query, strategy=strategy)
+            for t in tables
+        ]
         with timer.phase("hostmerge"):
             merged = hostmerge.merge_payloads(payloads)
         from bqueryd_tpu.models.query import ResultPayload
@@ -693,14 +775,30 @@ class WorkerNode(WorkerBase):
         timer = PhaseTimer()
         args, kwargs = msg.get_args_kwargs()
         filename, groupby_cols, agg_list, where_terms = args[:4]
-        query = GroupByQuery(
-            groupby_cols,
-            agg_list,
-            where_terms or [],
-            aggregate=kwargs.get("aggregate", True),
-            expand_filter_column=kwargs.get("expand_filter_column"),
-            sole_payload=bool(msg.get("sole_shard")),
+        # a planning controller ships the compiled plan fragment alongside
+        # the reference-shaped params: the fragment is authoritative (it
+        # carries the rewritten query + the kernel-strategy hint); bare
+        # params keep working for mixed-version clusters and direct tests
+        fragment = (
+            msg.get_from_binary("plan") if msg.get("plan") else None
         )
+        strategy = None
+        if fragment:
+            from bqueryd_tpu.plan import fragment_to_query
+
+            query = fragment_to_query(fragment)
+            strategy = fragment.get("strategy")
+            if strategy in (None, "auto"):
+                strategy = None
+        else:
+            query = GroupByQuery(
+                groupby_cols,
+                agg_list,
+                where_terms or [],
+                aggregate=kwargs.get("aggregate", True),
+                expand_filter_column=kwargs.get("expand_filter_column"),
+                sole_payload=bool(msg.get("sole_shard")),
+            )
         filenames = filename if isinstance(filename, list) else [filename]
         tables = []
         with timer.phase("open"):
@@ -733,7 +831,9 @@ class WorkerNode(WorkerBase):
             else:
                 profiling = contextlib.nullcontext()
             with profiling:
-                payload = self._execute(tables, query, timer)
+                payload = self._execute(
+                    tables, query, timer, strategy=strategy
+                )
             with timer.phase("serialize"):
                 data = payload.to_bytes()
             if cache is not None and len(data) <= cache.max_bytes // 8:
@@ -748,6 +848,13 @@ class WorkerNode(WorkerBase):
         reply = msg.copy()
         reply["data"] = data
         reply["phase_timings"] = timer.as_dict()
+        # deadline propagation: the reply keeps the envelope's ``deadline``
+        # (msg.copy) and reports the budget left after execution
+        remaining = msg.deadline_remaining()
+        if remaining is not None:
+            reply["deadline_remaining"] = round(remaining, 4)
+        if strategy is not None:
+            reply["strategy"] = strategy
         self.logger.debug("calc %s done: %s", filename, timer.as_dict())
         return reply
 

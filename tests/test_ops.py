@@ -1028,7 +1028,7 @@ def test_worker_degrades_mesh_runtime_error_to_engine(tmp_path, caplog):
     class _FailingMesh:
         timer = None
 
-        def execute(self, tables, query):
+        def execute(self, tables, query, strategy=None):
             raise jax.errors.JaxRuntimeError(
                 "INTERNAL: remote_compile: HTTP 500: tpu_compile_helper "
                 "subprocess exit code 1"
@@ -1178,3 +1178,46 @@ def test_host_sorted_count_distinct_matches_device():
         ),
         np.zeros(5, np.int64),
     )
+
+
+def test_expand_mask_host_twin_out_of_range_parity(monkeypatch):
+    """ADVICE r5 low #2: the wedged numpy twin of expand_mask_by_group must
+    mirror the device twin's edge semantics for codes >= n_groups — the jit
+    scatter silently DROPS out-of-range ids and the jit gather CLAMPS, where
+    an unguarded fancy index raised IndexError instead."""
+    from bqueryd_tpu.ops.groupby import _expand_mask_jit
+    from bqueryd_tpu.utils import devicehealth
+
+    n_groups = 4
+    codes = np.array([0, 1, 7, 3, -1, 9, 3], dtype=np.int64)  # 7, 9 OOB
+    mask = np.array([True, False, True, True, False, True, False])
+
+    device = np.asarray(_expand_mask_jit(codes, mask, n_groups))
+    monkeypatch.setattr(devicehealth, "backend_wedged", lambda **kw: True)
+    host = np.asarray(gb.expand_mask_by_group(codes, mask, n_groups=n_groups))
+    np.testing.assert_array_equal(host, device)
+    # and the baseline in-range case still matches pandas-style semantics:
+    # any selected row selects its whole group, null groups never selected
+    codes2 = np.array([0, 0, 1, 2, -1, 2], dtype=np.int64)
+    mask2 = np.array([True, False, False, False, True, True])
+    host2 = np.asarray(
+        gb.expand_mask_by_group(codes2, mask2, n_groups=3)
+    )
+    np.testing.assert_array_equal(
+        host2, [True, True, False, True, False, True]
+    )
+
+
+def test_term_mask_wedged_rejects_device_arrays(monkeypatch):
+    """ADVICE r5 low #1: the wedged branch must fail fast on a jax Array
+    instead of np.asarray-ing it (a blocking device transfer — the exact
+    hang the branch exists to avoid)."""
+    import jax.numpy as jnp
+
+    from bqueryd_tpu.utils import devicehealth
+
+    monkeypatch.setattr(devicehealth, "backend_wedged", lambda **kw: True)
+    host = pred.term_mask(np.array([1, 2, 3]), "==", 2)
+    np.testing.assert_array_equal(np.asarray(host), [False, True, False])
+    with pytest.raises(TypeError, match="wedged"):
+        pred.term_mask(jnp.array([1, 2, 3]), "==", 2)
