@@ -364,13 +364,15 @@ def check_result(result_df, base_df, groupby_cols, agg_list, config):
 
 
 def _phase_total(timings):
-    """Sum of the worker's per-phase totals across shard-group entries."""
+    """Sum of the worker's per-phase totals across shard-group entries.
+    The whole-call wall is the namespaced ``_total`` key (messages.py
+    schema); ``total`` is accepted for replies from older workers."""
     if not timings:
         return None
     total = 0.0
     for entry in timings.values():
         if isinstance(entry, dict):
-            total += float(entry.get("total", 0.0))
+            total += float(entry.get("_total", entry.get("total", 0.0)))
     return round(total, 4)
 
 
@@ -851,6 +853,146 @@ def main():
                 "route"
             )
 
+        # observability: registry snapshots bracket a headline groupby wall
+        # (perf regressions come with phase attribution for free — the
+        # histogram delta IS the phase breakdown of the measured queries),
+        # plus the metrics hot-path overhead gate: spans + histogram
+        # observes must stay under 2% of the adaptive wall.  Soft by
+        # default (recorded + loudly printed; CPU-backend walls are noisy);
+        # BENCH_OBS_STRICT=1 hard-asserts.
+        obs_detail = {}
+        if (
+            os.environ.get("BENCH_OBSERVABILITY", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            from bqueryd_tpu import obs as obs_mod
+
+            controller_node, worker_node = nodes[0], nodes[1]
+            files, gcols, aggs, where = config_query(HEADLINE, names)
+            try:
+                obs_detail["registry_before"] = {
+                    "counters": dict(controller_node.counters),
+                    "controller_histograms":
+                        controller_node.metrics.histogram_snapshot(),
+                    "worker_histograms":
+                        worker_node.metrics.histogram_snapshot(),
+                }
+                rpc.groupby(files, gcols, aggs, where)  # warmup
+                on_walls, off_walls = [], []
+                # paired walls are CONTEXT, not the gate: per-pair deltas on
+                # this class of shared box swing ±500 ms at a 1.1 s wall
+                # (measured), so no wall comparison can resolve the ~0.2 ms
+                # true cost.  Pairs alternate order (on-first / off-first)
+                # to cancel the measured ordering bias.
+                traced_id = None
+                for i in range(max(REPEATS, 10)):
+
+                    def one(enabled):
+                        obs_mod.set_enabled(enabled)
+                        try:
+                            t0 = time.perf_counter()
+                            rpc.groupby(files, gcols, aggs, where)
+                            return time.perf_counter() - t0
+                        finally:
+                            obs_mod.set_enabled(True)
+
+                    if i % 2 == 0:
+                        on_walls.append(one(True))
+                        # from an ENABLED call: disabled calls store no
+                        # timeline, their last_trace_id resolves to None
+                        traced_id = rpc.last_trace_id
+                        off_walls.append(one(False))
+                    else:
+                        off_walls.append(one(False))
+                        on_walls.append(one(True))
+                        traced_id = rpc.last_trace_id
+                import statistics
+
+                on_wall, off_wall = min(on_walls), min(off_walls)
+                deltas = [a - b for a, b in zip(on_walls, off_walls)]
+                paired_delta_pct = (
+                    statistics.median(deltas)
+                    / statistics.median(off_walls) * 100.0
+                )
+                # THE GATE: deterministic microcost of the per-query obs
+                # work (span recording sized from the real sample trace,
+                # the worker/controller histogram observes + family
+                # lookups, timeline assembly), as a fraction of the
+                # measured adaptive wall.  This is what "<2% overhead"
+                # can actually certify on a noisy box.
+                sample = controller_node.trace_store.get(traced_id) or {}
+                n_spans = max(len(sample.get("spans", [])), 8)
+                scratch = obs_mod.MetricsRegistry()
+                K = 2000
+                t0 = time.perf_counter()
+                for _ in range(K):
+                    rec = obs_mod.SpanRecorder(
+                        trace_id="bench" * 6, node="bench"
+                    )
+                    for _s in range(n_spans - 1):
+                        rec.record("phase", time.time(), 0.01)
+                    exported = rec.export()
+                    sorted(exported, key=lambda s: s["start_ts"])
+                    for name in ("a", "b", "c"):
+                        scratch.histogram(
+                            "bqueryd_tpu_scratch_seconds", "x",
+                            labels={"phase": name},
+                        ).observe(0.01)
+                    scratch.histogram(
+                        "bqueryd_tpu_scratch_total_seconds", "x"
+                    ).observe(0.01)
+                per_query_obs_s = (time.perf_counter() - t0) / K
+                hot_path_pct = (
+                    per_query_obs_s / statistics.median(on_walls) * 100.0
+                )
+                obs_detail["registry_after"] = {
+                    "counters": dict(controller_node.counters),
+                    "controller_histograms":
+                        controller_node.metrics.histogram_snapshot(),
+                    "worker_histograms":
+                        worker_node.metrics.histogram_snapshot(),
+                }
+                # one assembled waterfall as evidence the trace path is live
+                obs_detail["sample_trace"] = controller_node.trace_store.get(
+                    traced_id
+                )
+                obs_detail["metrics_on_wall_s"] = round(on_wall, 4)
+                obs_detail["metrics_off_wall_s"] = round(off_wall, 4)
+                obs_detail["paired_wall_delta_pct"] = round(
+                    paired_delta_pct, 2
+                )
+                obs_detail["hot_path_cost_ms"] = round(
+                    per_query_obs_s * 1e3, 3
+                )
+                obs_detail["overhead_pct"] = round(hot_path_pct, 3)
+                within = hot_path_pct <= 2.0
+                obs_detail["overhead_within_2pct"] = within
+                print(
+                    f"[bench] observability overhead: hot path "
+                    f"{per_query_obs_s*1e3:.2f} ms/query = "
+                    f"{hot_path_pct:.3f}% of the adaptive wall "
+                    f"(paired wall delta {paired_delta_pct:+.2f}%, "
+                    f"noise context)"
+                    + ("" if within else "  ** OVER THE 2% BUDGET **"),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                assert within, (
+                    f"metrics hot path costs {per_query_obs_s*1e3:.2f} ms "
+                    f"per query = {hot_path_pct:.2f}% of the adaptive "
+                    f"wall (budget: 2%)"
+                )
+            except Exception as exc:
+                obs_mod.set_enabled(True)
+                if isinstance(exc, AssertionError):
+                    raise  # the hot-path budget gate is deterministic: fail
+                print(
+                    f"[bench] observability section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         if HEADLINE in completed:
             head_name = HEADLINE
         elif completed:
@@ -898,6 +1040,9 @@ def main():
             # adaptive-vs-static route walls + the plan_pruned_shards /
             # shared-dispatch / admission counters from the controller
             "planner": planner_detail,
+            # registry snapshots bracketing the headline walls + the
+            # metrics-hot-path overhead gate + a sample trace waterfall
+            "observability": obs_detail,
             "total_s": round(time.time() - t_start, 1),
         }
         with open(detail_path, "w") as f:
@@ -944,6 +1089,7 @@ def main():
                         "plan_pruned_shards": planner_detail.get(
                             "plan_counters", {}
                         ).get("plan_pruned_shards"),
+                        "obs_overhead_pct": obs_detail.get("overhead_pct"),
                         "total_s": full_detail["total_s"],
                     },
                 }

@@ -42,6 +42,11 @@ def configure_logging(loglevel=logging.INFO):
     applications keep control of their logging config.  (The reference
     configured a stream handler as an import side effect, reference
     bqueryd/__init__.py:6-10.)
+
+    ``BQUERYD_TPU_LOG_JSON=1`` switches the handler to structured JSON lines
+    carrying ``trace_id``/``query_id`` correlation fields (see
+    :mod:`bqueryd_tpu.obs.logs`) so fleet logs join against the RPC trace
+    waterfall.
     """
     has_stream = any(
         isinstance(h, logging.StreamHandler)
@@ -50,9 +55,16 @@ def configure_logging(loglevel=logging.INFO):
     )
     if not has_stream:
         handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
-        )
+        if os.environ.get("BQUERYD_TPU_LOG_JSON") == "1":
+            from bqueryd_tpu.obs.logs import JsonLogFormatter
+
+            handler.setFormatter(JsonLogFormatter())
+        else:
+            handler.setFormatter(
+                logging.Formatter(
+                    "%(asctime)s %(name)s %(levelname)s %(message)s"
+                )
+            )
         logger.addHandler(handler)
     logger.setLevel(loglevel)
 

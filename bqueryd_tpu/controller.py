@@ -60,7 +60,24 @@ RUNFILE_DIR = os.environ.get("BQUERYD_TPU_RUNFILE_DIR", "/srv")
 CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
     "download", "readfile", "execute_code", "sleep", "groupby",
+    "trace", "metrics", "slow_queries",
 )
+
+#: help text for every controller counter — the spec the registry-backed
+#: ``counters`` dict (obs.metrics.RegistryCounters) is built from; the same
+#: keys keep working as plain dict entries everywhere (tests, bench, info)
+COUNTER_SPECS = {
+    "plan_pruned_shards": "shards excluded at plan time by advertised stats",
+    "plan_shared_dispatches": "identical concurrent work fused into one dispatch",
+    "plan_strategy_hints": "non-auto kernel-strategy hints issued",
+    "admission_busy": "BUSY backpressure replies sent to clients",
+    "admission_queued": "plans held in the admission wait queue",
+    "admission_superseded": "abandoned queries retired early on resend",
+    "deadline_expired": "work expired by its deadline before running",
+    "dispatched_shards": "groupby CalcMessages sent to workers",
+    "queries_completed": "groupby parents finished (reply sent or aborted)",
+    "slow_queries": "finished queries past BQUERYD_TPU_SLOW_QUERY_MS",
+}
 
 
 class ControllerNode:
@@ -128,16 +145,51 @@ class ControllerNode:
         self._work_subscribers = {}   # shard token -> [parent_token, ...]
         self._work_keys = {}          # shard token -> shared-dispatch key
         self._work_index = {}         # shared-dispatch key -> shard token
-        self.counters = {
-            "plan_pruned_shards": 0,      # shards excluded at plan time
-            "plan_shared_dispatches": 0,  # fused identical-work dispatches
-            "plan_strategy_hints": 0,     # non-auto kernel hints issued
-            "admission_busy": 0,          # BUSY backpressure replies
-            "admission_queued": 0,        # plans held in the wait queue
-            "admission_superseded": 0,    # abandoned queries retired early
-            "deadline_expired": 0,        # work expired before running
-            "dispatched_shards": 0,       # groupby CalcMessages sent out
-        }
+        # -- observability ---------------------------------------------------
+        from bqueryd_tpu import obs
+
+        self.metrics = obs.MetricsRegistry()
+        # the ad-hoc counters dict, migrated: same dict surface, every write
+        # mirrored into a typed registry Counter (Prometheus exposition)
+        self.counters = obs.RegistryCounters(self.metrics, COUNTER_SPECS)
+        # liveness gauges are callback-backed: read at scrape time, no upkeep
+        self.metrics.gauge(
+            "bqueryd_tpu_admission_active",
+            "plans currently executing", fn=lambda: len(self.admission._active),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_admission_queue_depth",
+            "plans waiting in the admission queue",
+            fn=lambda: len(self.admission._queued),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_inflight_shards",
+            "shard dispatches awaiting a worker reply",
+            fn=lambda: len(self.inflight),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_workers_known",
+            "workers currently registered", fn=lambda: len(self.worker_map),
+        )
+        self.query_seconds = self.metrics.histogram(
+            "bqueryd_tpu_groupby_seconds",
+            "end-to-end groupby wall at the controller (admission to reply)",
+        )
+        self.admission_wait_seconds = self.metrics.histogram(
+            "bqueryd_tpu_admission_wait_seconds",
+            "time queued in admission before launch",
+        )
+        # admission wait observations ride the controller's hook so the
+        # admission module stays metrics-agnostic
+        self.admission.wait_observer = self._observe_admission_wait
+        self.trace_store = obs.TraceStore()
+        self.slow_queries = obs.SlowQueryLog()
+        self._worker_metrics = {}     # worker_id -> last histogram snapshot
+        self._worker_metrics_rev = 0  # bumped on absorb/remove (cache key)
+        self._worker_hist_cache = (-1, None)  # (rev, merged aggregate)
+        from bqueryd_tpu.obs import http as obs_http
+
+        self._metrics_server = obs_http.maybe_start(self.metrics, self.logger)
         self.msg_count_in = 0
         self.start_time = time.time()
         self.running = False
@@ -227,6 +279,9 @@ class ControllerNode:
             self.store.srem(bqueryd_tpu.REDIS_SET_KEY, self.address)
         except Exception:
             pass
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         self._remove_runfiles()
         if not self.socket.closed:
             self.socket.close()
@@ -305,6 +360,8 @@ class ControllerNode:
 
     def remove_worker(self, worker_id):
         self.worker_map.pop(worker_id, None)
+        if self._worker_metrics.pop(worker_id, None) is not None:
+            self._worker_metrics_rev += 1
         for filename in list(self.files_map):
             self.files_map[filename].discard(worker_id)
             if not self.files_map[filename]:
@@ -315,6 +372,22 @@ class ControllerNode:
             if entry["worker"] == worker_id:
                 self.inflight.pop(token)
                 self._requeue(entry)
+
+    def _absorb_worker_metrics(self, worker_id, info):
+        """Latest histogram snapshot per worker (rides the WRM like shard
+        stats); aggregated by bucket-vector addition in get_info."""
+        snap = info.get("metrics")
+        if isinstance(snap, dict) and snap != self._worker_metrics.get(
+            worker_id
+        ):
+            # equality check before the rev bump: an idle fleet heartbeats
+            # identical snapshots, and bumping on those would defeat the
+            # aggregate memo in _aggregate_worker_histograms
+            self._worker_metrics[worker_id] = snap
+            self._worker_metrics_rev += 1
+        # keep worker_map lean: the snapshot lives in _worker_metrics; a
+        # second copy per worker entry would bloat get_info and peer gossip
+        info.pop("metrics", None)
 
     def _absorb_shard_stats(self, info):
         """Planning stats ride the WRM; keep the freshest copy per shard.
@@ -433,6 +506,15 @@ class ControllerNode:
             child.set_args_kwargs([filename] + list(args[1:]), kwargs)
             child["token"] = os.urandom(8).hex()
             child["filename"] = filename
+            # each child is its own dispatch attempt: a fresh trace hop +
+            # queue clock (same rule as _requeue), or every child's
+            # dispatch/calc spans would share the batch's one span_id
+            wire = child.get_trace()
+            if wire:
+                wire = dict(wire)
+                wire["span_id"] = os.urandom(8).hex()
+                child.set_trace(wire)
+                child["_dispatch_queued_ts"] = time.time()
             children.append(child)
         return children
 
@@ -507,6 +589,7 @@ class ControllerNode:
             return
         if msg.isa("groupby"):
             self.counters["dispatched_shards"] += 1
+        self._record_dispatch_span(msg, worker_id)
         if worker_id in self.worker_map:
             self.worker_map[worker_id]["busy"] = True
             # a successful dispatch is proof of liveness: the send would have
@@ -521,6 +604,34 @@ class ControllerNode:
                 "parent": msg.get("parent_token"),
                 "retries": msg.get("_retries", 0),
             }
+
+    def _record_dispatch_span(self, msg, worker_id):
+        """One "dispatch" span per successful send: queue-entry -> send, its
+        span_id the CalcMessage's trace hop (the worker's calc span parents
+        to it).  Recorded into EVERY live subscriber segment so shared
+        dispatches appear on each joined query's timeline."""
+        from bqueryd_tpu import obs
+
+        wire = msg.get_trace()
+        queued_ts = msg.get("_dispatch_queued_ts")
+        if not wire or queued_ts is None or not obs.enabled():
+            return
+        span = obs.make_span(
+            wire["trace_id"], "dispatch", queued_ts,
+            max(time.time() - float(queued_ts), 0.0),
+            span_id=wire["span_id"],
+            parent_span_id=wire.get("parent_span_id"),
+            node=self.address,
+            tags={
+                "worker": worker_id,
+                "filename": str(msg.get("filename")),
+                "retries": msg.get("_retries", 0),
+            },
+        )
+        for parent in self._work_parents(msg):
+            segment = self.rpc_segments.get(parent)
+            if segment is not None and segment.get("obs"):
+                segment["obs"]["spans"].append(span)
 
     def retry_stale_dispatches(self):
         """Requeue in-flight work whose worker stopped heartbeating (after
@@ -572,6 +683,15 @@ class ControllerNode:
             )
             return
         msg["_retries"] = retries + 1 if charge_retry else retries
+        # each dispatch ATTEMPT is its own trace hop: a fresh span_id (a
+        # slow-but-alive first worker's calc span keeps parenting to the
+        # original attempt's recorded span) and a fresh queue-entry clock
+        wire = msg.get_trace()
+        if wire:
+            wire = dict(wire)
+            wire["span_id"] = os.urandom(8).hex()
+            msg.set_trace(wire)
+            msg["_dispatch_queued_ts"] = time.time()
         affinity = msg.get("affinity")
         self.worker_out_messages.setdefault(affinity, []).append(msg)
 
@@ -636,6 +756,7 @@ class ControllerNode:
                     # loop for it); dropping it here would suppress fresh
                     # stats for a whole re-advertise window
                     self._absorb_shard_stats(msg)
+                    self._absorb_worker_metrics(worker_id, msg)
                 elif self._adoption_blocked.get(worker_id, 0) > now:
                     # quarantined: this worker was hard-culled as an hb_only
                     # adoptee whose main loop never spoke — its heartbeat
@@ -659,6 +780,7 @@ class ControllerNode:
                     for filename in info.get("data_files") or []:
                         self.files_map.setdefault(filename, set()).add(worker_id)
                     self._absorb_shard_stats(info)
+                    self._absorb_worker_metrics(worker_id, info)
                 return
             prev = self.worker_map.get(worker_id, {})
             self._adoption_blocked.pop(worker_id, None)  # main loop is back
@@ -678,6 +800,7 @@ class ControllerNode:
                         del self.files_map[filename]
                         self.shard_stats.pop(filename, None)
             self._absorb_shard_stats(info)
+            self._absorb_worker_metrics(worker_id, info)
             return
         if worker_id not in self.worker_map:
             # a message from a culled worker: ask it to re-register by just
@@ -742,6 +865,13 @@ class ControllerNode:
             delivered = True
             segment["results"][key] = msg.get("data") or b""
             segment["timings"][key] = msg.get("phase_timings")
+            # worker-side spans (calc root + phases) fold into the timeline;
+            # shared dispatches land on every subscriber's segment
+            spans = msg.get("spans")
+            if isinstance(spans, list) and segment.get("obs"):
+                segment["obs"]["spans"].extend(
+                    s for s in spans if isinstance(s, dict)
+                )
             self._maybe_complete_segment(p)
         if not delivered:
             self.logger.warning("orphaned result for parent %s dropped", parent)
@@ -771,29 +901,125 @@ class ControllerNode:
         # compact key: a batched shard-group is labelled by its first
         # file + count, not the joined list (a 10-shard join produced a
         # 130+ char key that bloated the bench's one-line JSON past what
-        # log tails keep intact)
-        timings = {
-            (k[0] if len(k) == 1 else f"{k[0]}+{len(k) - 1}more"): v
-            for k, v in segment["timings"].items()
-        }
+        # log tails keep intact); same labelling as the slow-query log
+        timings = self._compact_timings(segment["timings"])
         reply = pickle.dumps(
             {"ok": True, "payloads": payloads, "timings": timings},
             protocol=4,
         )
         self._finish_segment(parent, segment, reply)
 
-    def _finish_segment(self, parent, segment, reply_bytes=None):
+    def _finish_segment(self, parent, segment, reply_bytes=None, error=None):
         """Final reply for a groupby parent + admission slot release.
         ``reply_bytes=None`` finishes silently (a cancelled query whose
         client is no longer waiting — replying would mis-pair with the
         identity's next request)."""
         if reply_bytes is not None:
             self.reply_rpc_raw(segment["client_token"], reply_bytes)
+        self._finalize_query_obs(parent, segment, error=error)
         ticket = segment.get("admission_ticket")
         if ticket is not None:
             self.admission.release(ticket)
             self._ticket_sigs.pop(ticket, None)
             self._admit_ready()
+
+    @staticmethod
+    def _new_obs_state(ctx):
+        """Per-query trace state: the client's context, the controller
+        "groupby" span id every query span parents to, the span list the
+        timeline is assembled from, and the submit clock the admission
+        span measures against."""
+        from bqueryd_tpu import obs
+
+        return {
+            "trace_id": ctx.trace_id,
+            "root_span_id": ctx.span_id,
+            "qspan_id": obs.new_id(),
+            "spans": [],
+            "submitted_ts": time.time(),
+        }
+
+    def _observe_admission_wait(self, wait_s):
+        """Admission's wait hook: queued time before launch."""
+        from bqueryd_tpu import obs
+
+        if obs.enabled():
+            self.admission_wait_seconds.observe(wait_s)
+
+    @staticmethod
+    def _compact_timings(timings):
+        """Tuple-keyed per-shard timings -> JSON-safe compact keys (same
+        labelling as the client reply: first file + count for a group)."""
+        return {
+            (k[0] if len(k) == 1 else f"{k[0]}+{len(k) - 1}more"): v
+            for k, v in (timings or {}).items()
+        }
+
+    def _finalize_query_obs(self, parent, segment, error=None):
+        """Every finished groupby parent (success, abort, or silent
+        supersede) lands here exactly once: latency histogram observation,
+        timeline assembly into the trace ring buffer, slow-query check."""
+        from bqueryd_tpu import obs
+
+        wall = time.perf_counter() - segment.get(
+            "created_clock", time.perf_counter()
+        )
+        self.counters["queries_completed"] += 1
+        obs_state = segment.get("obs")
+        if not obs.enabled():
+            return
+        self.query_seconds.observe(wall)
+        if not obs_state:
+            return
+        trace_id = obs_state["trace_id"]
+        # the parent span opens at SUBMIT (so its admission-wait child can
+        # never start before it) and closes now: queue wait + execution
+        submitted = obs_state.get("submitted_ts", segment["created"])
+        spans = [
+            obs.make_span(
+                trace_id, "groupby", submitted,
+                wall + max(segment["created"] - submitted, 0.0),
+                span_id=obs_state["qspan_id"],
+                parent_span_id=obs_state["root_span_id"],
+                node=self.address,
+                tags={"parent_token": parent},
+            )
+        ]
+        for span in obs_state["spans"]:
+            # shared-dispatch worker spans were recorded under the trace of
+            # whichever subscriber created the work unit — retag so every
+            # timeline is self-consistent
+            span = dict(span)
+            span["trace_id"] = trace_id
+            spans.append(span)
+        spans.sort(key=lambda s: s.get("start_ts", 0.0))
+        timeline = {
+            "trace_id": trace_id,
+            "ok": error is None,
+            "wall_s": round(wall, 6),
+            "created_ts": segment["created"],
+            "filenames": list(segment["filenames"]),
+            "pruned": list(segment.get("pruned", ())),
+            "spans": spans,
+        }
+        if error is not None:
+            timeline["error"] = str(error)[:500]
+        self.trace_store.put(trace_id, timeline)
+        recorded = self.slow_queries.maybe_record(
+            wall,
+            {
+                "trace_id": trace_id,
+                "ok": error is None,
+                **({"error": str(error)[:200]} if error is not None else {}),
+                "filenames": len(segment["filenames"]),
+                "pruned_shards": len(segment.get("pruned", ())),
+                "plan_signature": segment.get("plan_sig"),
+                "strategy_hints": dict(segment.get("strategies", {})),
+                "phase_timings": self._compact_timings(segment.get("timings")),
+            },
+        )
+        if recorded:
+            self.counters["slow_queries"] += 1
 
     def abort_parent(self, parent, error_text, reply=True):
         segment = self.rpc_segments.pop(parent, None)
@@ -827,6 +1053,7 @@ class ControllerNode:
             pickle.dumps(
                 {"ok": False, "error": str(error_text)}, protocol=4
             ) if reply else None,
+            error=error_text,
         )
 
     def reply_rpc_raw(self, client_token, payload_bytes):
@@ -884,6 +1111,30 @@ class ControllerNode:
         reply.add_as_binary("result", self.get_info())
         self.reply_rpc_message(msg.get("token"), reply)
 
+    def rpc_metrics(self, msg):
+        """Prometheus text exposition of this controller's registry — the
+        RPC twin of the opt-in /metrics HTTP endpoint."""
+        reply = msg.copy()
+        reply.add_as_binary("result", self.metrics.render())
+        self.reply_rpc_message(msg.get("token"), reply)
+
+    def rpc_trace(self, msg):
+        """The assembled per-query timeline for one trace_id (or None when
+        it fell out of the ring buffer): ``rpc.trace(rpc.last_trace_id)``."""
+        args, _ = msg.get_args_kwargs()
+        trace_id = args[0] if args else None
+        reply = msg.copy()
+        reply.add_as_binary("result", self.trace_store.get(trace_id))
+        self.reply_rpc_message(msg.get("token"), reply)
+
+    def rpc_slow_queries(self, msg):
+        """The slow-query ring buffer (threshold BQUERYD_TPU_SLOW_QUERY_MS),
+        newest last: plan signature, strategy hints, pruned-shard count, and
+        phase breakdown per offender."""
+        reply = msg.copy()
+        reply.add_as_binary("result", self.slow_queries.entries())
+        self.reply_rpc_message(msg.get("token"), reply)
+
     def get_info(self, include_peers=True):
         info = {
             "address": self.address,
@@ -899,10 +1150,30 @@ class ControllerNode:
             "counters": dict(self.counters),
             "admission": self.admission.stats(),
             "shard_stats_known": len(self.shard_stats),
+            # every worker's latency histograms, merged by bucket-vector
+            # addition (identical fixed buckets are the precondition, see
+            # obs.metrics) — rides peer gossip too, so any controller can
+            # answer for the fleet
+            "worker_histograms": self._aggregate_worker_histograms(),
+            "trace_buffer": len(self.trace_store),
+            "slow_queries": len(self.slow_queries),
         }
         if include_peers:
             info["others"] = self.others
         return info
+
+    def _aggregate_worker_histograms(self):
+        # memoized on the snapshot revision: get_info runs once per peer per
+        # gossip tick, and redoing the O(workers x histograms) vector merge
+        # for each peer when nothing changed is pure waste
+        rev, cached = self._worker_hist_cache
+        if rev == self._worker_metrics_rev:
+            return cached
+        from bqueryd_tpu import obs
+
+        merged = obs.merge_histogram_snapshots(self._worker_metrics.values())
+        self._worker_hist_cache = (self._worker_metrics_rev, merged)
+        return merged
 
     def rpc_loglevel(self, msg):
         args, _ = msg.get_args_kwargs()
@@ -1038,6 +1309,7 @@ class ControllerNode:
         inflight growth), and launches via :meth:`_launch_plan`, which
         prunes shards against advertised stats, fuses identical concurrent
         work, and stamps each dispatch with a kernel-strategy hint."""
+        from bqueryd_tpu import obs
         from bqueryd_tpu import plan as planmod
 
         args, kwargs = msg.get_args_kwargs()
@@ -1046,6 +1318,16 @@ class ControllerNode:
                 "groupby needs (filenames, groupby_cols, agg_list, where_terms)"
             )
         filenames, groupby_cols, agg_list, where_terms = args
+        # tracing: adopt the client's TraceContext (mint one for traceless
+        # clients); the controller "groupby" span parents every query span
+        # and is itself a child of the client's root span
+        ctx = obs.TraceContext.from_wire(msg.get_trace())
+        if ctx is None:
+            ctx = obs.TraceContext.new_root()
+        obs_state = self._new_obs_state(ctx)
+        msg["_obs"] = obs_state
+        plan_start = time.time()
+        plan_clock = time.perf_counter()
         # dedup, order-preserving (inside plan compilation): duplicates would
         # double-count on the batched path and deadlock the per-shard path
         plan = planmod.plan_groupby(
@@ -1053,6 +1335,14 @@ class ControllerNode:
             aggregate=kwargs.get("aggregate", True),
             expand_filter_column=kwargs.get("expand_filter_column"),
         )
+        if obs.enabled():
+            obs_state["spans"].append(
+                obs.make_span(
+                    ctx.trace_id, "plan", plan_start,
+                    time.perf_counter() - plan_clock,
+                    parent_span_id=obs_state["qspan_id"], node=self.address,
+                )
+            )
         unknown = [f for f in plan.filenames if f not in self.files_map]
         if unknown:
             raise ValueError(f"filenames not found on any worker: {unknown}")
@@ -1192,10 +1482,27 @@ class ControllerNode:
             self._admitting = False
 
     def _launch_plan(self, msg, plan, kwargs):
+        from bqueryd_tpu import obs
         from bqueryd_tpu import plan as planmod
 
         parent_token = os.urandom(8).hex()
         planner_on = planmod.planner_enabled()
+        # observability state: created in rpc_groupby; a traceless caller
+        # (tests driving _launch_plan directly) gets a fresh one here
+        obs_state = msg.get("_obs")
+        if not isinstance(obs_state, dict):
+            obs_state = self._new_obs_state(obs.TraceContext.new_root())
+        # the admission span covers submit -> launch: ~0 for an immediate
+        # ADMIT, the real queue wait for plans launched by _admit_ready
+        if obs.enabled():
+            obs_state["spans"].append(
+                obs.make_span(
+                    obs_state["trace_id"], "admission",
+                    obs_state["submitted_ts"],
+                    max(time.time() - obs_state["submitted_ts"], 0.0),
+                    parent_span_id=obs_state["qspan_id"], node=self.address,
+                )
+            )
 
         # plan-time shard pruning: a shard whose advertised min/max stats
         # exclude the pushed-down predicate conjunction is never dispatched —
@@ -1222,8 +1529,14 @@ class ControllerNode:
             "results": {(f,): b"" for f in pruned},
             "timings": {},
             "created": time.time(),
+            # monotonic anchor for the reported wall (an NTP step must not
+            # produce a negative or inflated query latency observation)
+            "created_clock": time.perf_counter(),
             "admission_ticket": msg["token"],
             "pruned": list(pruned),
+            "obs": obs_state,
+            "plan_sig": str(plan.signature()),
+            "strategies": {},         # hint -> dispatch count
         }
         self.rpc_segments[parent_token] = segment
         if not keep:
@@ -1268,6 +1581,12 @@ class ControllerNode:
                     strategy = None
                 else:
                     self.counters["plan_strategy_hints"] += 1
+            segment = self.rpc_segments.get(parent_token)
+            if segment is not None:
+                hint = strategy or "auto"
+                segment["strategies"][hint] = (
+                    segment["strategies"].get(hint, 0) + len(group)
+                )
             # multi-query batching: identical pending work is joined, not
             # re-dispatched.  The deadline is part of the identity: fusing
             # across deadlines would let one client's budget expire (or
@@ -1297,6 +1616,21 @@ class ControllerNode:
             shard["parent_token"] = parent_token
             shard["filename"] = target
             shard["affinity"] = affinity
+            # per-dispatch trace hop: the worker parents its "calc" span to
+            # this dispatch span id; the span itself is recorded at send
+            # time (queue wait + routing), see _send_to_worker
+            obs_state = (
+                self.rpc_segments.get(parent_token, {}).get("obs") or {}
+            )
+            if obs_state:
+                shard.set_trace(
+                    {
+                        "trace_id": obs_state["trace_id"],
+                        "span_id": os.urandom(8).hex(),
+                        "parent_span_id": obs_state["qspan_id"],
+                    }
+                )
+                shard["_dispatch_queued_ts"] = time.time()
             if msg.get("deadline") is not None:
                 shard["deadline"] = msg["deadline"]
             shard.add_as_binary(

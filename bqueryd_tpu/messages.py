@@ -19,6 +19,24 @@ Deliberate fixes over the reference (flagged in SURVEY.md §7.4):
 Security note: like the reference (reference README.md:129) pickled payloads
 assume a trusted network.  ``Message.get_from_binary`` is the single choke
 point, so a restricted unpickler can be installed here later.
+
+Observability envelope schema (all keys optional, all JSON-safe — nodes
+without them interoperate):
+
+* ``trace`` — the distributed-tracing context injected by the RPC client and
+  propagated on every hop: ``{"trace_id": hex, "span_id": hex,
+  "parent_span_id": hex?}`` (:class:`bqueryd_tpu.obs.trace.TraceContext`).
+  ``span_id`` is the SENDER's active span; the receiver parents its root
+  span to it.  ``Message.set_trace``/``get_trace`` are the accessors.
+* ``spans`` — on worker calc REPLIES: the worker's span list (see
+  ``obs.trace.make_span`` for the per-span fields) which the controller
+  folds into the query's ``rpc.trace(trace_id)`` timeline.
+* ``phase_timings`` — on worker calc replies: ``{phase_name: seconds, ...,
+  "_total": seconds}``.  Phase keys are the worker's own phase names
+  (``open``, ``align``, ``mask``, ``layout``, ``aggregate``, ``collect``,
+  ``serialize``, ``hostmerge``, ...); the synthetic whole-call wall lives
+  under the underscore-namespaced ``_total`` key precisely so it can never
+  collide with (and silently overwrite) a real phase named ``total``.
 """
 
 import base64
@@ -100,6 +118,25 @@ class Message(dict):
     def deadline_expired(self, now=None):
         remaining = self.deadline_remaining(now)
         return remaining is not None and remaining <= 0
+
+    # -- tracing -----------------------------------------------------------
+    # The trace context is a plain dict (schema in the module docstring) so
+    # this module stays stdlib-only; obs.trace.TraceContext.from_wire parses
+    # it at the hops that record spans.
+    def set_trace(self, wire):
+        """Attach a wire TraceContext dict (or a TraceContext via its
+        ``to_wire``); None clears."""
+        if wire is None:
+            self.pop("trace", None)
+            return
+        if hasattr(wire, "to_wire"):
+            wire = wire.to_wire()
+        self["trace"] = dict(wire)
+
+    def get_trace(self):
+        """The wire TraceContext dict, or None."""
+        wire = self.get("trace")
+        return wire if isinstance(wire, dict) else None
 
     # -- call params -------------------------------------------------------
     def set_args_kwargs(self, args, kwargs):

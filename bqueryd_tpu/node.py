@@ -59,8 +59,31 @@ def main(argv=None):
         default=None,
         help="coordination store url (redis:// | mem:// | file://)",
     )
+    parser.add_argument(
+        "--metrics_port",
+        default=None,
+        type=int,
+        help=(
+            "serve Prometheus /metrics (+ /healthz) on this port "
+            "(0 = ephemeral; default off — same as BQUERYD_TPU_METRICS_PORT)"
+        ),
+    )
+    parser.add_argument(
+        "--log_json",
+        action="store_true",
+        help=(
+            "structured JSON log lines with trace_id/query_id correlation "
+            "(same as BQUERYD_TPU_LOG_JSON=1)"
+        ),
+    )
     parser.add_argument("-v", action="count", default=0, help="-v/-vv for debug")
     args = parser.parse_args(argv)
+    # flags translate to the env knobs the node constructors read, so
+    # supervisor/systemd configs and ad-hoc CLI runs configure identically
+    if args.metrics_port is not None:
+        os.environ["BQUERYD_TPU_METRICS_PORT"] = str(args.metrics_port)
+    if args.log_json:
+        os.environ["BQUERYD_TPU_LOG_JSON"] = "1"
 
     config = read_config()
     coordination_url = (

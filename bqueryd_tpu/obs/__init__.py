@@ -1,0 +1,69 @@
+"""Observability: metrics registry, distributed tracing, structured logging.
+
+The serving-layer seeing-eye the reference never had (its whole surface was
+``rpc.last_call_duration``, reference bqueryd/rpc.py:128-129).  Three pillars,
+each its own module:
+
+* :mod:`.metrics` — typed Counter/Gauge/Histogram on a per-node registry,
+  Prometheus text rendering (``rpc.metrics()`` + the opt-in ``/metrics``
+  endpoint in :mod:`.http`), log-scale latency buckets whose cross-worker
+  merge is a vector add;
+* :mod:`.trace`   — TraceContext propagation client→controller→worker→merge,
+  span recording per phase, and the controller's timeline ring buffer behind
+  ``rpc.trace(trace_id)``;
+* :mod:`.logs`    — JSON log formatter carrying trace/query/node correlation
+  ids, and the slow-query ring buffer behind ``rpc.slow_queries()``.
+
+The hot path (span recording + histogram observes) can be disabled with
+``BQUERYD_TPU_METRICS=0`` (or :func:`set_enabled`) — bench.py measures the
+enabled-vs-disabled delta and holds it under 2% of the adaptive wall.  The
+controller's logic counters (pruning, admission) are NOT gated: they steer
+behaviour, not just visibility.
+
+Control-plane package: stdlib only, safe to import in every process.
+"""
+
+import os
+
+from bqueryd_tpu.obs.logs import (  # noqa: F401
+    JsonLogFormatter,
+    SlowQueryLog,
+    bind_log_context,
+    log_context,
+    slow_query_threshold_ms,
+)
+from bqueryd_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RegistryCounters,
+    merge_histogram_snapshots,
+)
+from bqueryd_tpu.obs.trace import (  # noqa: F401
+    PHASE_SPAN_NAMES,
+    TRACE_KEY,
+    SpanRecorder,
+    TraceContext,
+    TraceStore,
+    current_trace,
+    make_span,
+    new_id,
+    use_trace,
+)
+
+_enabled = True
+
+
+def enabled():
+    """Whether the observability hot path (spans + histogram observes) is on.
+    ``BQUERYD_TPU_METRICS=0`` (read per call: live-tunable) or
+    :func:`set_enabled(False)` turns it off; logic counters stay live."""
+    return _enabled and os.environ.get("BQUERYD_TPU_METRICS", "1") != "0"
+
+
+def set_enabled(value):
+    """Process-wide switch (bench's overhead measurement seam)."""
+    global _enabled
+    _enabled = bool(value)

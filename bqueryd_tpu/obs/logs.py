@@ -1,0 +1,110 @@
+"""Structured logging + slow-query ring buffer.
+
+``JsonLogFormatter`` renders every log record as one JSON object carrying
+``trace_id``/``query_id``/``node_id`` so a fleet's logs correlate back to the
+RPC trace waterfall (``rpc.trace(trace_id)``).  The correlation fields come
+from a contextvar set by the node while it handles a query
+(:func:`bind_log_context`), so deep call stacks (kernels, storage) need no
+plumbing.  Opt in with ``BQUERYD_TPU_LOG_JSON=1``
+(:func:`bqueryd_tpu.configure_logging` installs the formatter).
+
+``SlowQueryLog`` is the controller's ring buffer of offending queries: every
+finished groupby whose wall clock exceeds ``BQUERYD_TPU_SLOW_QUERY_MS``
+(default 1000; read per call so a live controller can be re-tuned, 0 records
+everything) is kept with its plan signature, strategy hints, pruned-shard
+count, and per-shard phase breakdown — queryable over ``rpc.slow_queries()``.
+
+Control-plane module: stdlib only.
+"""
+
+import collections
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import time
+
+_log_ctx = contextvars.ContextVar("bqueryd_tpu_log_ctx", default=None)
+
+DEFAULT_SLOW_QUERY_MS = 1000.0
+
+
+def log_context():
+    """The correlation dict bound to this thread/task (may be None)."""
+    return _log_ctx.get()
+
+
+@contextlib.contextmanager
+def bind_log_context(**fields):
+    """Bind correlation fields (trace_id=..., query_id=...) for the block;
+    nested binds merge over the outer ones."""
+    merged = dict(_log_ctx.get() or {})
+    merged.update({k: v for k, v in fields.items() if v is not None})
+    token = _log_ctx.set(merged)
+    try:
+        yield
+    finally:
+        _log_ctx.reset(token)
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per record: ts, level, logger, msg, node_id plus any
+    bound correlation fields and exception text."""
+
+    def __init__(self, node_id=None):
+        super().__init__()
+        self.node_id = node_id
+
+    def format(self, record):
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if self.node_id is not None:
+            out["node_id"] = self.node_id
+        ctx = _log_ctx.get()
+        if ctx:
+            out.update(ctx)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def slow_query_threshold_ms():
+    """Read per call so a live node can be re-tuned; invalid values fall
+    back to the default rather than disabling the log silently."""
+    raw = os.environ.get("BQUERYD_TPU_SLOW_QUERY_MS")
+    if raw is None:
+        return DEFAULT_SLOW_QUERY_MS
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_SLOW_QUERY_MS
+
+
+class SlowQueryLog:
+    """Bounded ring buffer (``capacity`` newest offenders kept)."""
+
+    def __init__(self, capacity=128):
+        self._entries = collections.deque(maxlen=max(1, capacity))
+
+    def maybe_record(self, wall_s, entry):
+        """Record ``entry`` if ``wall_s`` crosses the live threshold.
+        Returns True when recorded."""
+        if wall_s * 1000.0 < slow_query_threshold_ms():
+            return False
+        record = dict(entry)
+        record.setdefault("ts", time.time())
+        record["wall_ms"] = round(wall_s * 1000.0, 3)
+        self._entries.append(record)
+        return True
+
+    def entries(self):
+        """Newest last, JSON-safe."""
+        return list(self._entries)
+
+    def __len__(self):
+        return len(self._entries)

@@ -823,6 +823,20 @@ def host_partial_tables(codes, measures, ops, n_groups, mask=None,
     mod 2^64.  NumPy is the reference semantics the device kernels are
     tested against, so the two paths are interchangeable by construction.
     """
+    from bqueryd_tpu.utils.tracing import trace_span
+
+    # runtime (un-traced) host kernel: annotate it like the device phases so
+    # a BQUERYD_TPU_PROFILE=1 timeline shows host-routed queries too, tagged
+    # with the active trace_id (obs.trace)
+    with trace_span("host_kernel"):
+        return _host_partial_tables(
+            codes, measures, ops, n_groups, mask=mask,
+            null_sentinels=null_sentinels,
+        )
+
+
+def _host_partial_tables(codes, measures, ops, n_groups, mask=None,
+                         null_sentinels=None):
     import numpy as np
 
     codes = np.asarray(codes)

@@ -241,9 +241,14 @@ class MeshQueryExecutor:
 
         from bqueryd_tpu.utils.tracing import trace_span
 
-        # every phase is both wall-timed (PhaseTimer -> reply phase_timings)
-        # and, under BQUERYD_TPU_PROFILE=1, a jax.profiler TraceAnnotation
-        # span so device timelines carry the query-phase names
+        # every phase is wall-timed (PhaseTimer -> reply phase_timings), a
+        # distributed-tracing span when the timer carries a SpanRecorder
+        # (obs.trace: "layout" surfaces as "h2d_transfer", "aggregate" as
+        # "kernel" — the psum collective merge is fused into that compiled
+        # program — and "collect" as "merge", the materialization of the
+        # merged partials), and, under BQUERYD_TPU_PROFILE=1, a jax.profiler
+        # TraceAnnotation tagged with the active trace_id so device
+        # timelines line up with the RPC waterfall
         stack = contextlib.ExitStack()
         stack.enter_context(trace_span(name))
         if self.timer is not None:

@@ -62,6 +62,18 @@ class AdmissionController:
         self._heap = []      # (priority, deadline-or-inf, seq, ticket_id)
         self._seq = itertools.count()
         self._client_load = {}  # client -> active + queued count
+        self._enqueued_at = {}  # ticket_id -> monotonic enqueue time
+        #: observability hook: called with the queued-seconds of every ticket
+        #: that launches from the queue (the controller wires a latency
+        #: histogram here; this module stays metrics-agnostic)
+        self.wait_observer = None
+        # lifetime totals (stats()): the registry counters mirror these via
+        # the controller's counters dict; kept here too so a bare
+        # AdmissionController remains self-describing in tests/tools
+        self.total_admitted = 0
+        self.total_queued = 0
+        self.total_busy = 0
+        self.total_expired = 0
 
     # -- internals ----------------------------------------------------------
     def _charge(self, client, delta):
@@ -82,12 +94,15 @@ class AdmissionController:
         if self.client_quota > 0 and (
             self._client_load.get(client, 0) >= self.client_quota
         ):
+            self.total_busy += 1
             return BUSY
         if len(self._active) < self.max_active:
             self._active[ticket_id] = client
             self._charge(client, +1)
+            self.total_admitted += 1
             return ADMIT
         if len(self._queued) >= self.queue_depth:
+            self.total_busy += 1
             return BUSY
         entry = (
             float(priority or 0),
@@ -96,8 +111,10 @@ class AdmissionController:
             ticket_id,
         )
         self._queued[ticket_id] = (client, priority, deadline, payload)
+        self._enqueued_at[ticket_id] = time.monotonic()
         heapq.heappush(self._heap, entry)
         self._charge(client, +1)
+        self.total_queued += 1
         return QUEUED
 
     def pop_ready(self, now=None):
@@ -111,12 +128,22 @@ class AdmissionController:
             item = self._queued.pop(ticket_id, None)
             if item is None:
                 continue  # cancelled/expired earlier; stale heap entry
+            enqueued = self._enqueued_at.pop(ticket_id, None)
             client, _priority, deadline, payload = item
             if deadline is not None and deadline <= now:
                 self._charge(client, -1)
+                self.total_expired += 1
                 expired.append(payload)
                 continue
             self._active[ticket_id] = client
+            self.total_admitted += 1
+            if self.wait_observer is not None and enqueued is not None:
+                try:
+                    self.wait_observer(
+                        max(time.monotonic() - enqueued, 0.0)
+                    )
+                except Exception:
+                    pass  # an observer must never break admission
             launch.append(payload)
         # deadline sweep for tickets stuck behind higher-priority work
         if self._queued:
@@ -124,7 +151,9 @@ class AdmissionController:
                 client, _priority, deadline, payload = item
                 if deadline is not None and deadline <= now:
                     self._queued.pop(ticket_id, None)
+                    self._enqueued_at.pop(ticket_id, None)
                     self._charge(client, -1)
+                    self.total_expired += 1
                     expired.append(payload)
         return launch, expired
 
@@ -136,6 +165,7 @@ class AdmissionController:
             return True
         item = self._queued.pop(ticket_id, None)
         if item is not None:
+            self._enqueued_at.pop(ticket_id, None)
             self._charge(item[0], -1)
             return True
         return False
@@ -148,4 +178,8 @@ class AdmissionController:
             "queue_depth": self.queue_depth,
             "client_quota": self.client_quota,
             "clients": len(self._client_load),
+            "total_admitted": self.total_admitted,
+            "total_queued": self.total_queued,
+            "total_busy": self.total_busy,
+            "total_expired": self.total_expired,
         }

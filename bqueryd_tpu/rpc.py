@@ -63,6 +63,9 @@ class RPC:
         # unset, each socket identity is its own bucket
         self.client_id = client_id
         self.last_call_duration = None
+        #: trace id of the most recent call — feed it to ``rpc.trace(...)``
+        #: to pull the controller's per-phase waterfall for that query
+        self.last_trace_id = None
         self.identity = os.urandom(8).hex()
         self.store = coordination_store(
             coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
@@ -120,7 +123,10 @@ class RPC:
         return remote_call
 
     def _rpc(self, name, args, kwargs):
-        started = time.time()
+        # perf_counter, not time.time(): last_call_duration measures this
+        # process's elapsed time, and an NTP step mid-call used to make it
+        # negative (the reference's quirk, reference bqueryd/rpc.py:128-129)
+        started = time.perf_counter()
         if name == "groupby" and self.legacy_merge:
             # the sum-of-shard-means quirk needs per-shard payloads: disable
             # the controller's batched (pre-merged) shard-group dispatch
@@ -137,6 +143,14 @@ class RPC:
             msg["priority"] = priority
         if self.client_id is not None:
             msg["client_id"] = self.client_id
+        # end-to-end tracing: every call mints a root TraceContext; the
+        # controller parents its query spans to it and keeps the assembled
+        # timeline retrievable via rpc.trace(rpc.last_trace_id)
+        from bqueryd_tpu.obs.trace import TraceContext
+
+        ctx = TraceContext.new_root()
+        msg.set_trace(ctx)
+        self.last_trace_id = ctx.trace_id
         msg.set_args_kwargs(list(args), kwargs)
         wire = msg.to_json().encode()
         reply = None
@@ -163,7 +177,7 @@ class RPC:
         if reply is None:
             raise RPCError(f"rpc {name} failed: {last_error}")
         result = self._parse_reply(name, reply)
-        self.last_call_duration = time.time() - started
+        self.last_call_duration = time.perf_counter() - started
         return result
 
     def _parse_reply(self, name, reply):

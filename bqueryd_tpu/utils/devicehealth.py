@@ -196,6 +196,19 @@ def window_dirty(start_marker, end_marker=None):
     )
 
 
+def health_snapshot():
+    """Gauge-friendly state for the observability registry: read-only (never
+    launches a probe — metric scrapes must not spawn device dispatch threads
+    as a side effect).  ``{"wedged": 0/1, "abandoned_probes": n,
+    "wedge_generation": n}``."""
+    with _lock:
+        return {
+            "wedged": 1 if _wedged else 0,
+            "abandoned_probes": _abandoned,
+            "wedge_generation": _generation,
+        }
+
+
 def force_state(wedged):
     """Test seam: pin the latch without probing (also resets the interval
     clock so the next ``backend_wedged`` call does not immediately launch

@@ -4,45 +4,73 @@ The reference's only timing surface is a per-call wall clock on the client
 (reference bqueryd/rpc.py:128-129).  The TPU build needs to attribute a query's
 latency to its phases — storage decode, host→device transfer, kernel, and
 collective merge — so workers attach a :class:`PhaseTimer` to every calc result
-(surfaced in the reply under ``phase_timings``) and expose an opt-in
-``jax.profiler`` trace hook.
+(surfaced in the reply under ``phase_timings``; schema documented in
+:mod:`bqueryd_tpu.messages`) and expose an opt-in ``jax.profiler`` trace hook.
+
+A PhaseTimer may carry an :class:`bqueryd_tpu.obs.trace.SpanRecorder`: each
+phase then also records a distributed-tracing span (wall-clock start +
+perf_counter duration), which is how worker phases reach the controller's
+``rpc.trace(trace_id)`` waterfall without a second set of timing call sites.
+
+All durations use ``time.perf_counter`` — including :meth:`PhaseTimer.total`
+and the anchor it is measured from.  (``time.time()`` is NOT monotonic: an
+NTP step used to make totals negative or smaller than the phase sum.)
 """
 
 import contextlib
 import os
 import time
 
+#: synthetic key added by :meth:`PhaseTimer.as_dict` — deliberately
+#: underscore-namespaced so a real phase named ``total`` can never be
+#: silently overwritten (see the reply schema note in messages.py)
+TOTAL_KEY = "_total"
+
 
 class PhaseTimer:
-    """Accumulates named phase durations; phases may recur (times sum)."""
+    """Accumulates named phase durations; phases may recur (times sum).
 
-    def __init__(self):
+    ``recorder``/``span_names`` (optional): a SpanRecorder receiving one span
+    per phase occurrence, names mapped through ``span_names`` (e.g.
+    obs.trace.PHASE_SPAN_NAMES' ``open`` -> ``storage_decode``)."""
+
+    def __init__(self, recorder=None, span_names=None):
         self.timings = {}
-        self._started = time.time()
+        self.recorder = recorder
+        self.span_names = span_names or {}
+        self._started = time.perf_counter()
 
     @contextlib.contextmanager
     def phase(self, name):
+        start_ts = time.time()
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.timings[name] = self.timings.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            duration = time.perf_counter() - t0
+            self.timings[name] = self.timings.get(name, 0.0) + duration
+            if self.recorder is not None:
+                self.recorder.record(
+                    self.span_names.get(name, name), start_ts, duration
+                )
 
     def total(self):
-        return time.time() - self._started
+        return time.perf_counter() - self._started
 
     def as_dict(self):
         out = dict(self.timings)
-        out["total"] = self.total()
+        out[TOTAL_KEY] = self.total()
         return out
 
 
 @contextlib.contextmanager
 def trace_span(name):
     """A ``jax.profiler.TraceAnnotation`` span when JAX is importable and
-    profiling is enabled via BQUERYD_TPU_PROFILE=1; otherwise a no-op."""
+    profiling is enabled via BQUERYD_TPU_PROFILE=1; otherwise a no-op.
+
+    When a distributed TraceContext is active (obs.trace contextvar), the
+    annotation is tagged with its ``trace_id`` so device profiler timelines
+    line up with the RPC trace waterfall."""
     annotation = None
     if os.environ.get("BQUERYD_TPU_PROFILE") == "1":
         try:
@@ -50,7 +78,16 @@ def trace_span(name):
         except ImportError:
             pass
         else:
-            annotation = jax.profiler.TraceAnnotation(name)
+            kwargs = {}
+            try:
+                from bqueryd_tpu.obs.trace import current_trace
+
+                ctx = current_trace()
+                if ctx is not None:
+                    kwargs["trace_id"] = ctx.trace_id
+            except Exception:
+                pass
+            annotation = jax.profiler.TraceAnnotation(name, **kwargs)
     if annotation is not None:
         with annotation:
             yield

@@ -98,6 +98,27 @@ class WorkerBase:
         self.gc_interval = gc_interval
         self._last_gc = time.time()
 
+        # -- observability ---------------------------------------------------
+        from bqueryd_tpu import obs
+        from bqueryd_tpu.obs import http as obs_http
+
+        self.metrics = obs.MetricsRegistry()
+        self.metrics.gauge(
+            "bqueryd_tpu_worker_rss_bytes",
+            "resident set size of this worker process",
+            fn=self._rss_bytes,
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_worker_uptime_seconds",
+            "seconds since this worker process started",
+            fn=lambda: time.time() - self.start_time,
+        )
+        self.work_errors = self.metrics.counter(
+            "bqueryd_tpu_worker_errors_total",
+            "work items that raised (returned as ErrorMessage)",
+        )
+        self._metrics_server = obs_http.maybe_start(self.metrics, self.logger)
+
         self.context = zmq.Context.instance()
         self.socket = self.context.socket(zmq.ROUTER)
         self.socket.identity = self.worker_id.encode()
@@ -163,12 +184,21 @@ class WorkerBase:
             self._hb_thread.join(timeout=2.0)
         return external
 
+    @staticmethod
+    def _rss_bytes():
+        import psutil
+
+        return psutil.Process(os.getpid()).memory_info().rss
+
     def stop(self):
         # doubles as a cross-thread shutdown REQUEST (tests, embedders):
         # the flag ends the loop and the loop thread re-enters here for the
         # actual socket teardown
         if self._request_stop_only():
             return
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
         for addr in list(self.controllers):
             try:
                 self.send(addr, StopMessage({"worker_id": self.worker_id}))
@@ -309,6 +339,10 @@ class WorkerBase:
         return stats
 
     def prepare_wrm(self):
+        # getattr defence: embedders and tests build workers piecemeal
+        # (__new__), and a missing registry must never break the WRM
+        # heartbeat (same rule as shard_stats)
+        registry = getattr(self, "metrics", None)
         return WorkerRegisterMessage(
             {
                 "worker_id": self.worker_id,
@@ -336,6 +370,13 @@ class WorkerBase:
                 # strategy selection; None for non-calc roles and for beats
                 # where the unchanged stats were advertised recently
                 "shard_stats": self._stats_to_advertise(),
+                # latency histogram snapshot (fixed buckets, JSON-safe):
+                # controllers aggregate these fleet-wide by bucket-vector
+                # addition (get_info "worker_histograms" + peer gossip)
+                "metrics": (
+                    registry.histogram_snapshot()
+                    if registry is not None else None
+                ),
             }
         )
 
@@ -412,21 +453,37 @@ class WorkerBase:
 
     # -- work --------------------------------------------------------------
     def handle(self, msg, sender):
+        from bqueryd_tpu import obs
+
         busy = BusyMessage({"worker_id": self.worker_id})
         self.send_to_all(busy)
-        try:
-            if msg.deadline_expired():
-                # the client's budget is already gone: burning kernel time on
-                # an answer nobody is waiting for starves admitted queries
-                raise TimeoutError(
-                    f"deadline exceeded "
-                    f"{-msg.deadline_remaining():.3f}s before execution"
-                )
-            result = self.handle_work(msg)
-        except Exception:
-            self.logger.exception("error handling work")
-            result = ErrorMessage(msg)
-            result["payload"] = traceback.format_exc()
+        wire = msg.get_trace()
+        log_fields = {
+            "trace_id": (wire or {}).get("trace_id"),
+            "query_id": msg.get("parent_token") or msg.get("token"),
+        }
+        # correlation ids on every log line this work emits (JSON
+        # formatter), and the active TraceContext for trace_span tagging;
+        # the except body stays INSIDE the bind — the failure traceback is
+        # the log line that most needs to join the rpc.trace() waterfall
+        with obs.bind_log_context(**log_fields), obs.use_trace(
+            obs.TraceContext.from_wire(wire)
+        ):
+            try:
+                if msg.deadline_expired():
+                    # the client's budget is already gone: burning kernel
+                    # time on an answer nobody is waiting for starves
+                    # admitted queries
+                    raise TimeoutError(
+                        f"deadline exceeded "
+                        f"{-msg.deadline_remaining():.3f}s before execution"
+                    )
+                result = self.handle_work(msg)
+            except Exception:
+                self.logger.exception("error handling work")
+                self.work_errors.inc()
+                result = ErrorMessage(msg)
+                result["payload"] = traceback.format_exc()
         if result is not None:
             try:
                 self.send(sender, result)
@@ -542,6 +599,28 @@ class WorkerNode(WorkerBase):
         self._table_cache = {}
         self._stats_collector = None
         self._warmup_thread = None
+        # device-health gauges: read-only snapshots (never launch a probe
+        # from a metrics scrape) — operators see the wedge latch and its
+        # probe debt wherever they already scrape worker metrics
+        snap = devicehealth.health_snapshot
+        self.metrics.gauge(
+            "bqueryd_tpu_backend_wedged",
+            "1 while the accelerator backend is latched as wedged",
+            fn=lambda: snap()["wedged"],
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_device_probes_abandoned",
+            "health probes written off as hung since the last success",
+            fn=lambda: snap()["abandoned_probes"],
+        )
+        self.groupby_queries = self.metrics.counter(
+            "bqueryd_tpu_worker_groupby_total",
+            "groupby CalcMessages executed by this worker",
+        )
+        self.groupby_seconds = self.metrics.histogram(
+            "bqueryd_tpu_worker_groupby_seconds",
+            "whole-CalcMessage wall on the worker (open to serialize)",
+        )
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
         from bqueryd_tpu import ops
@@ -770,9 +849,22 @@ class WorkerNode(WorkerBase):
         if not msg.isa("groupby"):
             return super().handle_work(msg)
 
+        from bqueryd_tpu import obs
         from bqueryd_tpu.models.query import GroupByQuery
 
-        timer = PhaseTimer()
+        # distributed tracing: phases double as spans (PhaseTimer records
+        # into the recorder), the worker's "calc" root span parents to the
+        # controller's dispatch span via the envelope TraceContext
+        recorder = None
+        if obs.enabled():
+            ctx = obs.TraceContext.from_wire(msg.get_trace())
+            recorder = obs.SpanRecorder(
+                trace_id=ctx.trace_id if ctx else obs.new_id(16),
+                node=self.worker_id,
+                root_name="calc",
+                root_parent=ctx.span_id if ctx else None,
+            )
+        timer = PhaseTimer(recorder=recorder, span_names=obs.PHASE_SPAN_NAMES)
         args, kwargs = msg.get_args_kwargs()
         filename, groupby_cols, agg_list, where_terms = args[:4]
         # a planning controller ships the compiled plan fragment alongside
@@ -848,6 +940,21 @@ class WorkerNode(WorkerBase):
         reply = msg.copy()
         reply["data"] = data
         reply["phase_timings"] = timer.as_dict()
+        if recorder is not None:
+            # the span list rides the JSON reply; the controller folds it
+            # into the query timeline behind rpc.trace(trace_id)
+            reply["spans"] = recorder.export()
+            self.groupby_queries.inc()
+            self.groupby_seconds.observe(timer.total())
+            for phase, seconds in timer.timings.items():
+                self.metrics.histogram(
+                    "bqueryd_tpu_query_phase_seconds",
+                    "per-phase worker latency (storage decode, H2D, "
+                    "kernel, merge, ...)",
+                    labels={
+                        "phase": obs.PHASE_SPAN_NAMES.get(phase, phase)
+                    },
+                ).observe(seconds)
         # deadline propagation: the reply keeps the envelope's ``deadline``
         # (msg.copy) and reports the budget left after execution
         remaining = msg.deadline_remaining()
@@ -911,6 +1018,14 @@ class DownloaderNode(WorkerBase):
             )
         self.download_threads = max(1, download_threads)
         self._download_pool = None
+        self.downloads_done = self.metrics.counter(
+            "bqueryd_tpu_downloads_total",
+            "download tickets completed by this node",
+        )
+        self.downloads_failed = self.metrics.counter(
+            "bqueryd_tpu_download_failures_total",
+            "download tickets failed terminally by this node",
+        )
         import queue
 
         self._outbox = queue.Queue()
@@ -991,6 +1106,7 @@ class DownloaderNode(WorkerBase):
         from bqueryd_tpu.download import remove_ticket
 
         remove_ticket(self, ticket)
+        self.downloads_done.inc()
         self._outbox.put(TicketDoneMessage({"ticket": ticket}))
 
     def fail_ticket(self, ticket, fileurl, error):
@@ -1000,6 +1116,7 @@ class DownloaderNode(WorkerBase):
         from bqueryd_tpu.download import fail_ticket
 
         fail_ticket(self, ticket, fileurl, error)
+        self.downloads_failed.inc()
         self._outbox.put(
             TicketDoneMessage({"ticket": ticket, "error": str(error)})
         )
