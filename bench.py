@@ -993,6 +993,70 @@ def main():
                     flush=True,
                 )
 
+        # profiling: the compile-side story of the whole bench run — the
+        # program registry (per-shape compiles, jit-cache reuse, HLO
+        # cost_analysis FLOPs/bytes) plus the persistent compile cache's
+        # hit rate, so a "this round got slower" diff can distinguish
+        # kernel regressions from cold-cache compile walls
+        profiling_detail = {}
+        if os.environ.get("BENCH_PROFILING", "1") == "1":
+            try:
+                from bqueryd_tpu.obs import profile as profile_mod
+
+                snap = profile_mod.profiler().snapshot(max_programs=16)
+                jit_total = snap["jit_cache_hits"] + snap["jit_cache_misses"]
+                persist_total = (
+                    snap["persistent_cache_hits"]
+                    + snap["persistent_cache_misses"]
+                )
+                profiling_detail = {
+                    "jit_cache_hits": snap["jit_cache_hits"],
+                    "jit_cache_misses": snap["jit_cache_misses"],
+                    "jit_cache_hit_rate": (
+                        round(snap["jit_cache_hits"] / jit_total, 4)
+                        if jit_total else None
+                    ),
+                    "persistent_cache_hits": snap["persistent_cache_hits"],
+                    "persistent_cache_misses":
+                        snap["persistent_cache_misses"],
+                    "persistent_cache_hit_rate": (
+                        round(
+                            snap["persistent_cache_hits"] / persist_total, 4
+                        )
+                        if persist_total else None
+                    ),
+                    "compile_count": sum(
+                        snap["compile_seconds"]["counts"]
+                    ),
+                    "compile_seconds_sum": round(
+                        snap["compile_seconds"]["sum"], 4
+                    ),
+                    "total_flops": sum(
+                        p["flops"] or 0 for p in snap["programs"]
+                    ),
+                    "programs_tracked": snap["programs_tracked"],
+                    # the registry itself: per-shape compiles/calls/costs
+                    "programs": snap["programs"],
+                    "compile_cache": profile_mod.compile_cache_info(),
+                    "runtime": profile_mod.runtime_versions(),
+                }
+                print(
+                    f"[bench] profiling: {profiling_detail['compile_count']} "
+                    f"compiles ({profiling_detail['compile_seconds_sum']:.2f}s"
+                    f" total), jit hit rate "
+                    f"{profiling_detail['jit_cache_hit_rate']}, persistent "
+                    f"cache hit rate "
+                    f"{profiling_detail['persistent_cache_hit_rate']}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as exc:
+                print(
+                    f"[bench] profiling section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         if HEADLINE in completed:
             head_name = HEADLINE
         elif completed:
@@ -1043,6 +1107,9 @@ def main():
             # registry snapshots bracketing the headline walls + the
             # metrics-hot-path overhead gate + a sample trace waterfall
             "observability": obs_detail,
+            # compile-cache hit rates + the per-shape program registry with
+            # cost_analysis FLOPs (obs.profile)
+            "profiling": profiling_detail,
             "total_s": round(time.time() - t_start, 1),
         }
         with open(detail_path, "w") as f:
@@ -1090,6 +1157,12 @@ def main():
                             "plan_counters", {}
                         ).get("plan_pruned_shards"),
                         "obs_overhead_pct": obs_detail.get("overhead_pct"),
+                        "jit_cache_hit_rate": profiling_detail.get(
+                            "jit_cache_hit_rate"
+                        ),
+                        "compile_seconds_sum": profiling_detail.get(
+                            "compile_seconds_sum"
+                        ),
                         "total_s": full_detail["total_s"],
                     },
                 }

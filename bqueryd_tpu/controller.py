@@ -60,7 +60,7 @@ RUNFILE_DIR = os.environ.get("BQUERYD_TPU_RUNFILE_DIR", "/srv")
 CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
     "download", "readfile", "execute_code", "sleep", "groupby",
-    "trace", "metrics", "slow_queries",
+    "trace", "metrics", "slow_queries", "health", "debug_bundle",
 )
 
 #: help text for every controller counter — the spec the registry-backed
@@ -77,6 +77,8 @@ COUNTER_SPECS = {
     "dispatched_shards": "groupby CalcMessages sent to workers",
     "queries_completed": "groupby parents finished (reply sent or aborted)",
     "slow_queries": "finished queries past BQUERYD_TPU_SLOW_QUERY_MS",
+    "health_avoided_dispatches":
+        "dispatch decisions that routed around a degraded/wedged worker",
 }
 
 
@@ -187,6 +189,49 @@ class ControllerNode:
         self._worker_metrics = {}     # worker_id -> last histogram snapshot
         self._worker_metrics_rev = 0  # bumped on absorb/remove (cache key)
         self._worker_hist_cache = (-1, None)  # (rev, merged aggregate)
+        # -- forensics & health (PR 3) --------------------------------------
+        # flight recorder: bounded always-on ring of envelopes/dispatches/
+        # timeouts/worker churn behind rpc.debug_bundle() + SIGUSR1
+        self.flight = obs.FlightRecorder(node_id=self.address)
+        # WRM-absorbed per-worker debug snapshots (flight tail + compile
+        # registry + device health).  DELIBERATELY kept after a worker is
+        # removed: a dead peer's last words are exactly what a debug bundle
+        # is for — bounded to the newest entries so churn can't grow it
+        self._worker_debug = {}       # worker_id -> {"data", "ts"}
+        self._worker_debug_cap = 64
+        self._worker_wedged = {}      # worker_id -> last advertised latch
+        # health scorer: rolling latency/error baselines from the WRM
+        # signals, fed back into find_free_worker's candidate ordering
+        self.health = obs.HealthScorer()
+        for name, help_text, fn in (
+            (
+                "bqueryd_tpu_trace_buffer_evictions",
+                "trace timelines evicted by the ring's entry/byte bounds "
+                "(monotonic)",
+                lambda: self.trace_store.evictions,
+            ),
+            (
+                "bqueryd_tpu_slow_query_evictions",
+                "slow-query entries evicted by the ring's entry/byte bounds "
+                "(monotonic)",
+                lambda: self.slow_queries.evictions,
+            ),
+            (
+                "bqueryd_tpu_flight_evictions",
+                "flight-ring events evicted by the entry/byte bounds "
+                "(monotonic)",
+                lambda: self.flight.evictions,
+            ),
+            (
+                "bqueryd_tpu_workers_degraded",
+                "registered workers currently scored degraded or wedged",
+                lambda: sum(
+                    1 for s in self.health.statuses().values()
+                    if s.get("status") != obs.STATUS_OK
+                ),
+            ),
+        ):
+            self.metrics.gauge(name, help_text, fn=fn)
         from bqueryd_tpu.obs import http as obs_http
 
         self._metrics_server = obs_http.maybe_start(self.metrics, self.logger)
@@ -233,6 +278,10 @@ class ControllerNode:
             # installs the same handler; reference nodes relied on process
             # teardown alone)
             signal.signal(signal.SIGTERM, self._term_signal)
+            if hasattr(signal, "SIGUSR1"):
+                # local forensic dump: kill -USR1 <pid> writes the full
+                # debug bundle without needing a live client
+                signal.signal(signal.SIGUSR1, self._dump_debug_signal)
         except ValueError:
             pass  # not the main thread (in-process test clusters)
         self.logger.info("controller %s running", self.address)
@@ -359,7 +408,13 @@ class ControllerNode:
             self.remove_worker(worker_id)
 
     def remove_worker(self, worker_id):
+        if worker_id in self.worker_map:
+            # forensic event (never gated); the worker's debug snapshot in
+            # _worker_debug deliberately survives for rpc.debug_bundle()
+            self.flight.record("worker_removed", worker=worker_id)
         self.worker_map.pop(worker_id, None)
+        self.health.remove(worker_id)
+        self._worker_wedged.pop(worker_id, None)
         if self._worker_metrics.pop(worker_id, None) is not None:
             self._worker_metrics_rev += 1
         for filename in list(self.files_map):
@@ -375,7 +430,10 @@ class ControllerNode:
 
     def _absorb_worker_metrics(self, worker_id, info):
         """Latest histogram snapshot per worker (rides the WRM like shard
-        stats); aggregated by bucket-vector addition in get_info."""
+        stats); aggregated by bucket-vector addition in get_info.  Also
+        feeds the WRM's health signals (histograms + error counter +
+        backend_wedged) into the health scorer, records wedge-latch flips
+        in the flight ring, and absorbs the worker's debug-bundle slice."""
         snap = info.get("metrics")
         if isinstance(snap, dict) and snap != self._worker_metrics.get(
             worker_id
@@ -388,6 +446,45 @@ class ControllerNode:
         # keep worker_map lean: the snapshot lives in _worker_metrics; a
         # second copy per worker entry would bloat get_info and peer gossip
         info.pop("metrics", None)
+        wedged = bool(info.get("backend_wedged"))
+        prev_wedged = self._worker_wedged.get(worker_id)
+        self._worker_wedged[worker_id] = wedged
+        if wedged and not prev_wedged:
+            # forensic event (never gated): the moment the fleet view
+            # learned this worker's accelerator latched
+            self.flight.record("worker_wedged", worker=worker_id)
+            self.logger.warning(
+                "worker %s advertises a wedged accelerator backend",
+                worker_id,
+            )
+        elif prev_wedged and not wedged:
+            self.flight.record("worker_unwedged", worker=worker_id)
+        # every heartbeat is a health sample, even when the histogram totals
+        # did not move — a silent window is itself signal (no throughput)
+        self.health.observe(
+            worker_id,
+            snapshot=self._worker_metrics.get(worker_id),
+            wedged=wedged,
+            errors=info.get("work_errors"),
+        )
+        debug = info.pop("debug", None)
+        if isinstance(debug, dict):
+            self._worker_debug[worker_id] = {
+                "data": debug, "ts": time.time(),
+            }
+            while len(self._worker_debug) > self._worker_debug_cap:
+                # evict dead peers' stale last-words before any live
+                # worker's slice: a fleet larger than the cap must never
+                # present a reporting worker as "partial" in the bundle
+                # (registered entries go only when everything is registered)
+                victim = min(
+                    self._worker_debug,
+                    key=lambda w: (
+                        w in self.worker_map,
+                        self._worker_debug[w]["ts"],
+                    ),
+                )
+                self._worker_debug.pop(victim, None)
 
     def _absorb_shard_stats(self, info):
         """Planning stats ride the WRM; keep the freshest copy per shard.
@@ -410,7 +507,16 @@ class ControllerNode:
         """Random choice among free calc workers, constrained to workers
         advertising ``filename`` — a single name or, for a batched shard
         group, a list the worker must advertise in full — and optionally to
-        this controller's host (reference bqueryd/controller.py:113-144)."""
+        this controller's host (reference bqueryd/controller.py:113-144).
+
+        Health-aware (the observability → scheduling feedback loop): among
+        eligible candidates, workers the :class:`obs.HealthScorer` flags
+        degraded/wedged are used only when no healthy candidate is free —
+        deprioritized, never excluded, so the sole holder of a shard still
+        serves it.  ``BQUERYD_TPU_HEALTH_ROUTING=0`` disables the
+        preference."""
+        from bqueryd_tpu.obs import health as health_mod
+
         needed = (
             [filename] if isinstance(filename, str) else list(filename or [])
         )
@@ -425,7 +531,14 @@ class ControllerNode:
             if needs_local and info.get("node") != self.node_name:
                 continue
             candidates.append(worker_id)
-        return random.choice(candidates) if candidates else None
+        if not candidates:
+            return None
+        if len(candidates) > 1 and health_mod.routing_enabled():
+            healthy = self.health.healthy_subset(candidates)
+            if healthy and len(healthy) < len(candidates):
+                self.counters["health_avoided_dispatches"] += 1
+                candidates = healthy
+        return random.choice(candidates)
 
     def dispatch_pending(self):
         """Drain affinity queues round-robin, one message per queue per tick
@@ -589,6 +702,20 @@ class ControllerNode:
             return
         if msg.isa("groupby"):
             self.counters["dispatched_shards"] += 1
+        from bqueryd_tpu import obs
+
+        # flight ring: every work envelope handed to a worker (hot path —
+        # kill-switch gated), the forensic counterpart of dispatch_timeout
+        if obs.enabled():
+            self.flight.record(
+                "dispatch",
+                worker=worker_id,
+                verb=msg.get("payload"),
+                token=msg.get("token"),
+                filename=str(msg.get("filename"))[:200]
+                if msg.get("filename") is not None else None,
+                trace_id=(msg.get_trace() or {}).get("trace_id"),
+            )
         self._record_dispatch_span(msg, worker_id)
         if worker_id in self.worker_map:
             self.worker_map[worker_id]["busy"] = True
@@ -659,6 +786,18 @@ class ControllerNode:
                 "dispatch %s to %s timed out (age %.0fs, worker %s)",
                 token, entry["worker"],
                 age, "alive" if worker_alive else "dead",
+            )
+            # forensic event (never gated): hard timeouts are one of the
+            # debug bundle's trigger conditions
+            self.flight.record(
+                "dispatch_timeout",
+                token=token,
+                worker=entry["worker"],
+                age_s=round(age, 3),
+                hard=age > self.dispatch_hard_timeout,
+                worker_alive=worker_alive,
+                filename=str(entry["msg"].get("filename"))[:200],
+                trace_id=(entry["msg"].get_trace() or {}).get("trace_id"),
             )
             self.inflight.pop(token)
             self._requeue(entry)
@@ -783,6 +922,13 @@ class ControllerNode:
                     self._absorb_worker_metrics(worker_id, info)
                 return
             prev = self.worker_map.get(worker_id, {})
+            if not prev:
+                self.flight.record(
+                    "worker_registered",
+                    worker=worker_id,
+                    workertype=msg.get("workertype"),
+                    node=msg.get("node"),
+                )
             self._adoption_blocked.pop(worker_id, None)  # main loop is back
             info = dict(msg)
             info["last_seen"] = now
@@ -966,8 +1112,26 @@ class ControllerNode:
         )
         self.counters["queries_completed"] += 1
         obs_state = segment.get("obs")
+        if error is not None:
+            # forensic event (never gated): failed queries are exactly what
+            # a debug bundle gets pulled for
+            self.flight.record(
+                "query_failed",
+                parent=parent,
+                trace_id=(obs_state or {}).get("trace_id"),
+                wall_s=round(wall, 6),
+                error=str(error)[:300],
+            )
         if not obs.enabled():
             return
+        if error is None:
+            self.flight.record(
+                "query_done",
+                parent=parent,
+                trace_id=(obs_state or {}).get("trace_id"),
+                wall_s=round(wall, 6),
+                shards=len(segment.get("filenames", ())),
+            )
         self.query_seconds.observe(wall)
         if not obs_state:
             return
@@ -1087,6 +1251,17 @@ class ControllerNode:
             return
         msg["token"] = token
         verb = msg.get("payload")
+        from bqueryd_tpu import obs
+
+        # flight ring: client envelopes (hot path — kill-switch gated; pings
+        # are connection noise, not forensics)
+        if verb != "ping" and obs.enabled():
+            self.flight.record(
+                "rpc",
+                verb=verb,
+                client=token[:12],
+                trace_id=(msg.get_trace() or {}).get("trace_id"),
+            )
         handler = getattr(self, f"rpc_{verb}", None)
         if verb not in CONTROLLER_VERBS or handler is None:
             err = ErrorMessage(msg)
@@ -1135,7 +1310,120 @@ class ControllerNode:
         reply.add_as_binary("result", self.slow_queries.entries())
         self.reply_rpc_message(msg.get("token"), reply)
 
+    def rpc_health(self, msg):
+        """Per-worker health statuses (ok/degraded/wedged) from the rolling
+        latency/error baselines — the view dispatch routing acts on."""
+        from bqueryd_tpu.obs import health as health_mod
+
+        reply = msg.copy()
+        reply.add_as_binary(
+            "result",
+            {
+                "workers": self.health.statuses(),
+                "routing_enabled": health_mod.routing_enabled(),
+            },
+        )
+        self.reply_rpc_message(msg.get("token"), reply)
+
+    def rpc_debug_bundle(self, msg):
+        """``rpc.debug_bundle(trace_id=None)``: the cross-node forensic
+        artifact (schema ``bqueryd_tpu.debug_bundle/1``) — flight rings,
+        the requested (or newest) trace timeline, metrics and slow-query
+        snapshots, per-worker compile registries and device health.  One
+        JSON-safe dict you can attach to a bug report; dead peers degrade
+        it (stale snapshots, ``partial`` list), never fail it."""
+        args, kwargs = msg.get_args_kwargs()
+        trace_id = args[0] if args else kwargs.get("trace_id")
+        reply = msg.copy()
+        reply.add_as_binary("result", self.build_debug_bundle(trace_id))
+        self.reply_rpc_message(msg.get("token"), reply)
+
+    def build_debug_bundle(self, trace_id=None):
+        """Assemble the debug artifact from controller-held state (no
+        blocking round-trips: worker slices come from absorbed WRM
+        heartbeats, so a wedged or dead worker can't stall the bundle)."""
+        from bqueryd_tpu import obs
+        from bqueryd_tpu.obs import profile as obs_profile
+
+        timeline = (
+            self.trace_store.get(trace_id)
+            if trace_id
+            else self.trace_store.latest()
+        )
+        controller_section = {
+            "address": self.address,
+            "node": self.node_name,
+            "uptime_s": round(time.time() - self.start_time, 3),
+            "flight": self.flight.events(),
+            "flight_evictions": self.flight.evictions,
+            "counters": dict(self.counters),
+            "admission": self.admission.stats(),
+            "workers_known": sorted(self.worker_map),
+            "inflight": {
+                token: {
+                    "worker": e["worker"],
+                    "age_s": round(time.time() - e["sent_at"], 3),
+                    "retries": e.get("retries", 0),
+                }
+                for token, e in self.inflight.items()
+            },
+            "health": self.health.statuses(),
+            "trace": timeline,
+            "slow_queries": self.slow_queries.entries(),
+            "metrics": self.metrics.histogram_snapshot(),
+            "worker_histograms": self._aggregate_worker_histograms(),
+            "runtime": obs_profile.runtime_versions(),
+            "compile_cache": obs_profile.compile_cache_info(),
+        }
+        snapshots = {}
+        for worker_id in set(self.worker_map) | set(self._worker_debug):
+            absorbed = self._worker_debug.get(worker_id)
+            snapshots[worker_id] = {
+                "data": absorbed["data"] if absorbed else None,
+                "ts": absorbed["ts"] if absorbed else None,
+                "registered": worker_id in self.worker_map,
+            }
+        # redaction roots: serving data dirs, the runfile dir, and the
+        # compile-cache path are operational facts; everything else path-
+        # shaped (home dirs, site-packages in tracebacks) is reduced to
+        # <redacted>/basename before the bundle can leave the cluster
+        allowed = {self.runfile_dir}
+        allowed.update(
+            info.get("data_dir") for info in self.worker_map.values()
+        )
+        cache_path = controller_section["compile_cache"].get("path")
+        if cache_path:
+            allowed.add(cache_path)
+        return obs.build_bundle(
+            controller_section,
+            snapshots,
+            trace_id=trace_id or (timeline or {}).get("trace_id"),
+            allowed_path_prefixes=[p for p in allowed if p],
+        )
+
+    def _dump_debug_signal(self, *args):
+        from bqueryd_tpu.obs import flightrec
+
+        try:
+            path = flightrec.dump_bundle(
+                self.build_debug_bundle(), role="controller"
+            )
+            self.logger.warning("SIGUSR1: debug bundle written to %s", path)
+        except Exception:
+            self.logger.exception("SIGUSR1 debug dump failed")
+
     def get_info(self, include_peers=True):
+        from bqueryd_tpu.obs import profile as obs_profile
+
+        health_runtime = {
+            "runtime": obs_profile.runtime_versions(),
+            "compile_cache": obs_profile.compile_cache_info(),
+            "worker_runtime": {
+                worker_id: (absorbed.get("data") or {}).get("runtime")
+                for worker_id, absorbed in self._worker_debug.items()
+                if worker_id in self.worker_map
+            },
+        }
         info = {
             "address": self.address,
             "node": self.node_name,
@@ -1157,6 +1445,14 @@ class ControllerNode:
             "worker_histograms": self._aggregate_worker_histograms(),
             "trace_buffer": len(self.trace_store),
             "slow_queries": len(self.slow_queries),
+            # heterogeneous-fleet triage facts (see ops/__init__.py's SIGILL
+            # note): this process's jax/jaxlib/libtpu versions + the
+            # persistent-compile-cache decision, plus every worker's own
+            # versions as gossiped in WRM debug slices
+            "runtime": health_runtime["runtime"],
+            "compile_cache": health_runtime["compile_cache"],
+            "worker_runtime": health_runtime["worker_runtime"],
+            "health": self.health.statuses(),
         }
         if include_peers:
             info["others"] = self.others

@@ -37,6 +37,14 @@ without them interoperate):
   ``serialize``, ``hostmerge``, ...); the synthetic whole-call wall lives
   under the underscore-namespaced ``_total`` key precisely so it can never
   collide with (and silently overwrite) a real phase named ``total``.
+* on WorkerRegisterMessages (all optional; controllers ignore what they
+  don't know): ``backend_wedged`` (bool, the device-health latch),
+  ``work_errors`` (cumulative error-counter total — the controller's
+  health scorer derives windowed error rates from its deltas),
+  ``metrics`` (histogram snapshot, see obs.metrics), and ``debug`` — the
+  node's debug-bundle slice (flight-ring tail, compile registry, device
+  health, runtime versions; see obs.flightrec) absorbed controller-side
+  so ``rpc.debug_bundle()`` can speak for dead peers.
 """
 
 import base64

@@ -14,11 +14,26 @@ each its own module:
 * :mod:`.logs`    — JSON log formatter carrying trace/query/node correlation
   ids, and the slow-query ring buffer behind ``rpc.slow_queries()``.
 
-The hot path (span recording + histogram observes) can be disabled with
-``BQUERYD_TPU_METRICS=0`` (or :func:`set_enabled`) — bench.py measures the
-enabled-vs-disabled delta and holds it under 2% of the adaptive wall.  The
-controller's logic counters (pruning, admission) are NOT gated: they steer
-behaviour, not just visibility.
+PR 3 adds the forensic/feedback tier:
+
+* :mod:`.profile`   — XLA compile-time histograms, jit/persistent-cache
+  hit/miss accounting, a per-shape program registry with cost_analysis
+  FLOPs/bytes, and HBM-watermark gauges (``device.memory_stats``);
+* :mod:`.flightrec` — a bounded always-on per-node flight ring plus the
+  ``rpc.debug_bundle()`` cross-node artifact assembly (SIGUSR1 dumps it
+  locally);
+* :mod:`.health`    — per-worker rolling latency/error baselines scored
+  ok/degraded/wedged behind ``rpc.health()``, fed back into dispatch
+  affinity (degraded workers are deprioritized, never excluded).
+
+The hot path (span recording + histogram observes + flight envelope events
++ compile-call accounting) can be disabled with ``BQUERYD_TPU_METRICS=0``
+(or :func:`set_enabled`) — bench.py measures the enabled-vs-disabled delta
+and holds it under 2% of the adaptive wall.  The controller's logic
+counters (pruning, admission) are NOT gated: they steer behaviour, not just
+visibility.  Forensic flight events (wedges, timeouts, worker removals,
+errors) are never gated either — rare by construction, and the reason the
+recorder exists.
 
 Control-plane package: stdlib only, safe to import in every process.
 """
@@ -52,6 +67,20 @@ from bqueryd_tpu.obs.trace import (  # noqa: F401
     new_id,
     use_trace,
 )
+from bqueryd_tpu.obs.flightrec import (  # noqa: F401
+    BUNDLE_SCHEMA,
+    FlightRecorder,
+    build_bundle,
+    dump_bundle,
+    redact_paths,
+)
+from bqueryd_tpu.obs.health import (  # noqa: F401
+    STATUS_DEGRADED,
+    STATUS_OK,
+    STATUS_WEDGED,
+    HealthScorer,
+)
+from bqueryd_tpu.obs import profile  # noqa: F401
 
 _enabled = True
 

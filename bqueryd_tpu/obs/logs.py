@@ -86,25 +86,61 @@ def slow_query_threshold_ms():
 
 
 class SlowQueryLog:
-    """Bounded ring buffer (``capacity`` newest offenders kept)."""
+    """Bounded ring buffer of offenders — bounded by BOTH entry count and
+    bytes (``BQUERYD_TPU_SLOW_QUERY_BYTES``, default 4 MiB): entries carry
+    per-shard phase breakdowns, so wide queries made the entry-only cap an
+    unbounded-memory promise on long-running controllers.  ``evictions``
+    counts entries dropped for either reason (exported as a gauge)."""
 
-    def __init__(self, capacity=128):
-        self._entries = collections.deque(maxlen=max(1, capacity))
+    DEFAULT_MAX_BYTES = 4 << 20
+
+    def __init__(self, capacity=128, max_bytes=None):
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get(
+                        "BQUERYD_TPU_SLOW_QUERY_BYTES",
+                        self.DEFAULT_MAX_BYTES,
+                    )
+                )
+            except ValueError:
+                max_bytes = self.DEFAULT_MAX_BYTES
+        self.capacity = max(1, capacity)
+        self.max_bytes = max(1024, max_bytes)
+        self._entries = collections.deque()
+        self._sizes = collections.deque()
+        self._nbytes = 0
+        self.evictions = 0
 
     def maybe_record(self, wall_s, entry):
         """Record ``entry`` if ``wall_s`` crosses the live threshold.
         Returns True when recorded."""
+        from bqueryd_tpu.obs.flightrec import approx_json_bytes
+
         if wall_s * 1000.0 < slow_query_threshold_ms():
             return False
         record = dict(entry)
         record.setdefault("ts", time.time())
         record["wall_ms"] = round(wall_s * 1000.0, 3)
+        size = approx_json_bytes(record)
         self._entries.append(record)
+        self._sizes.append(size)
+        self._nbytes += size
+        while len(self._entries) > self.capacity or (
+            self._nbytes > self.max_bytes and len(self._entries) > 1
+        ):
+            self._entries.popleft()
+            self._nbytes -= self._sizes.popleft()
+            self.evictions += 1
         return True
 
     def entries(self):
         """Newest last, JSON-safe."""
         return list(self._entries)
+
+    @property
+    def nbytes(self):
+        return self._nbytes
 
     def __len__(self):
         return len(self._entries)

@@ -227,6 +227,31 @@ class MetricsRegistry:
             self._families.setdefault(name, (cls.kind, help_text))
             return metric
 
+    def register(self, metric):
+        """Adopt an EXISTING metric instance (process-global metrics like
+        the compile profiler's histogram, shared across every node registry
+        in one process).  Re-registering the same instance is a no-op; a
+        different instance under a taken (name, labels) key is an error."""
+        key = (metric.name, frozenset(metric.labels.items()))
+        with self._lock:
+            hit = self._metrics.get(key)
+            if hit is metric:
+                return metric
+            if hit is not None:
+                raise ValueError(
+                    f"metric {metric.name!r} already registered with a "
+                    "different instance"
+                )
+            family = self._families.get(metric.name)
+            if family is not None and family[0] != metric.kind:
+                raise ValueError(
+                    f"metric {metric.name!r} already registered as "
+                    f"{family[0]}"
+                )
+            self._metrics[key] = metric
+            self._families.setdefault(metric.name, (metric.kind, metric.help))
+            return metric
+
     def counter(self, name, help_text, labels=None):
         return self._get_or_create(Counter, name, help_text, labels)
 
@@ -303,6 +328,26 @@ class MetricsRegistry:
                 if not re.match(r"^[a-z][a-z0-9_]*$", label):
                     problems.append(f"{metric.name}: bad label name {label!r}")
         return problems
+
+
+def readme_coverage_problems(registries, readme_text):
+    """Doc-coverage lint (run from tests alongside :meth:`MetricsRegistry.lint`
+    against live node registries): every registered metric family must be
+    named in the README's metrics documentation, or operators discover
+    metrics by grepping source.  Returns violation strings, empty = clean."""
+    problems = []
+    seen = set()
+    for registry in registries:
+        for metric in registry.metrics():
+            if metric.name in seen:
+                continue
+            seen.add(metric.name)
+            if metric.name not in readme_text:
+                problems.append(
+                    f"{metric.name}: registered but missing from the README "
+                    "metrics table"
+                )
+    return sorted(problems)
 
 
 def merge_histogram_snapshots(snapshots):

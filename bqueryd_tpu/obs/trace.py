@@ -185,8 +185,10 @@ class SpanRecorder:
                 parent_span_id=parent_span_id, tags=tags,
             )
 
-    def export(self):
-        """Root span + children, oldest first."""
+    def export(self, tags=None):
+        """Root span + children, oldest first.  ``tags`` land on the ROOT
+        span — per-unit facts that belong to the whole calc (e.g. the
+        worker's device-memory attribution for this query)."""
         root = make_span(
             self.trace_id,
             self._root_name,
@@ -195,6 +197,7 @@ class SpanRecorder:
             span_id=self.root_span_id,
             parent_span_id=self._root_parent,
             node=self.node,
+            tags=tags,
         )
         return [root] + sorted(self.spans, key=lambda s: s["start_ts"])
 
@@ -202,31 +205,70 @@ class SpanRecorder:
 class TraceStore:
     """Ring buffer of assembled per-query timelines, keyed by trace_id.
 
-    Capacity via ``BQUERYD_TPU_TRACE_BUFFER`` (default 256).  A timeline is
-    ``{"trace_id", "wall_s", "created_ts", "ok", "spans": [...]}`` plus any
-    extra keys the controller attaches (filenames, pruned count, ...)."""
+    Bounded by BOTH entry count (``BQUERYD_TPU_TRACE_BUFFER``, default 256)
+    and bytes (``BQUERYD_TPU_TRACE_BUFFER_BYTES``, default 16 MiB): span
+    counts scale with shard counts, so an entry-only cap let a long-running
+    controller's wide-query timelines grow without limit.  ``evictions``
+    counts entries dropped for either reason (exported as a gauge).  A
+    timeline is ``{"trace_id", "wall_s", "created_ts", "ok", "spans": [...]}``
+    plus any extra keys the controller attaches (filenames, pruned, ...)."""
 
-    def __init__(self, capacity=None):
+    DEFAULT_MAX_BYTES = 16 << 20
+
+    def __init__(self, capacity=None, max_bytes=None):
         if capacity is None:
             try:
                 capacity = int(os.environ.get("BQUERYD_TPU_TRACE_BUFFER", 256))
             except ValueError:
                 capacity = 256
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get(
+                        "BQUERYD_TPU_TRACE_BUFFER_BYTES",
+                        self.DEFAULT_MAX_BYTES,
+                    )
+                )
+            except ValueError:
+                max_bytes = self.DEFAULT_MAX_BYTES
         self.capacity = max(1, capacity)
+        self.max_bytes = max(1024, max_bytes)
         self._order = []    # trace_ids, oldest first
         self._store = {}
+        self._sizes = {}
+        self._nbytes = 0
+        self.evictions = 0
 
     def put(self, trace_id, timeline):
+        from bqueryd_tpu.obs.flightrec import approx_json_bytes
+
         if trace_id in self._store:
             self._order.remove(trace_id)
+            self._nbytes -= self._sizes.pop(trace_id, 0)
+        size = approx_json_bytes(timeline)
         self._store[trace_id] = timeline
+        self._sizes[trace_id] = size
+        self._nbytes += size
         self._order.append(trace_id)
-        while len(self._order) > self.capacity:
+        while len(self._order) > self.capacity or (
+            self._nbytes > self.max_bytes and len(self._order) > 1
+        ):
             evicted = self._order.pop(0)
             self._store.pop(evicted, None)
+            self._nbytes -= self._sizes.pop(evicted, 0)
+            self.evictions += 1
 
     def get(self, trace_id):
         return self._store.get(trace_id)
+
+    def latest(self):
+        """The newest timeline (or None) — the debug bundle's default when
+        no trace_id is requested."""
+        return self._store.get(self._order[-1]) if self._order else None
+
+    @property
+    def nbytes(self):
+        return self._nbytes
 
     def __len__(self):
         return len(self._store)

@@ -51,6 +51,10 @@ from bqueryd_tpu.models.query import (  # noqa: F401
     MERGEABLE_OPS,
     extremum_fill,
 )
+# compile/call accounting on the jit entry points below (obs.profile is
+# stdlib-only at import; the wrappers pass straight through under an outer
+# trace and under the BQUERYD_TPU_METRICS=0 kill switch)
+from bqueryd_tpu.obs import profile as _obsprofile
 
 
 def _accum_dtype(dtype):
@@ -706,6 +710,11 @@ def _partial_tables_mm(codes, measures, ops, n_groups, mask=None,
     return {"rows": rows_count, "aggs": tuple(aggs)}
 
 
+_partial_tables_mm = _obsprofile.instrument(
+    "ops.partial_tables_mm", _partial_tables_mm
+)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_groups", "ops", "null_sentinels", "force_sort"),
@@ -808,6 +817,11 @@ def _partial_tables_scatter(codes, measures, ops, n_groups, mask=None,
                 }
             )
     return {"rows": rows, "aggs": tuple(aggs)}
+
+
+_partial_tables_scatter = _obsprofile.instrument(
+    "ops.partial_tables_scatter", _partial_tables_scatter
+)
 
 
 def host_partial_tables(codes, measures, ops, n_groups, mask=None,
@@ -1167,6 +1181,11 @@ def groupby_count_distinct(codes, value_codes, n_groups, n_values, mask=None):
     )
 
 
+groupby_count_distinct = _obsprofile.instrument(
+    "ops.groupby_count_distinct", groupby_count_distinct
+)
+
+
 def expand_mask_by_group(group_codes, mask, n_groups=None):
     """Expand a row mask to whole groups: every row whose group contains at
     least one selected row becomes selected (the basket-expansion semantics of
@@ -1221,6 +1240,11 @@ def _expand_mask_jit(group_codes, mask, n_groups):
         (mask & valid).astype(jnp.int32), safe, num_segments=max(n_groups, 1),
     )
     return (hit[safe] > 0) & valid
+
+
+_expand_mask_jit = _obsprofile.instrument(
+    "ops.expand_mask", _expand_mask_jit
+)
 
 
 def host_sorted_count_distinct(codes, values, n_groups, mask=None):
@@ -1281,3 +1305,8 @@ def groupby_sorted_count_distinct(codes, values, n_groups, mask=None):
     return jax.ops.segment_sum(
         is_new_run.astype(jnp.int64), safe, num_segments=n_groups
     )
+
+
+groupby_sorted_count_distinct = _obsprofile.instrument(
+    "ops.groupby_sorted_count_distinct", groupby_sorted_count_distinct
+)

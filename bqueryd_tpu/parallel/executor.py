@@ -831,7 +831,12 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
         out_specs=P(),
         check=False,
     )
-    return jax.jit(fn), spec
+    # compile/call accounting (obs.profile): every mesh-program call lands
+    # in the jit-cache hit/miss counters, compiles in the compile-seconds
+    # histogram + per-shape program registry with cost_analysis FLOPs
+    from bqueryd_tpu.obs import profile as obsprofile
+
+    return obsprofile.instrument("executor.mesh_program", jax.jit(fn)), spec
 
 
 #: set when the packed program failed to build/run on this backend (seen

@@ -117,6 +117,19 @@ class WorkerBase:
             "bqueryd_tpu_worker_errors_total",
             "work items that raised (returned as ErrorMessage)",
         )
+        # flight recorder: the always-on forensic ring (envelopes, state
+        # transitions, errors, wedge latches) behind rpc.debug_bundle() —
+        # its tail rides WRMs so the controller can assemble a cross-node
+        # artifact even after this worker dies
+        self.flight = obs.FlightRecorder(node_id=self.worker_id)
+        self.metrics.gauge(
+            "bqueryd_tpu_flight_evictions",
+            "flight-ring events evicted by the entry/byte bounds (monotonic)",
+            fn=lambda: self.flight.evictions,
+        )
+        self._wedge_gen_seen = devicehealth.health_snapshot()[
+            "wedge_generation"
+        ]
         self._metrics_server = obs_http.maybe_start(self.metrics, self.logger)
 
         self.context = zmq.Context.instance()
@@ -143,6 +156,11 @@ class WorkerBase:
         self._loop_thread = threading.current_thread()
         try:
             signal.signal(signal.SIGTERM, self._term_signal)
+            if hasattr(signal, "SIGUSR1"):
+                # local forensic dump: kill -USR1 <pid> writes this node's
+                # debug snapshot (flight ring + compile registry + device
+                # health) as one JSON file without needing a live controller
+                signal.signal(signal.SIGUSR1, self._dump_debug_signal)
         except ValueError:
             pass  # not the main thread (in-process test clusters)
         self.logger.info("starting %s worker %s", self.workertype, self.worker_id)
@@ -338,11 +356,117 @@ class WorkerBase:
         self._stats_sent_ts = now
         return stats
 
+    def _backend_wedged(self):
+        """The device-health latch this worker advertises.  CALC workers own
+        the device, so their heartbeat ticks the probe clock too — an IDLE
+        wedged worker still recovers (and stops advertising wedged) without
+        waiting for a query.  Downloader/move roles never touch the device;
+        their reads stay passive so a WRM can never spawn a jax probe thread
+        as a side effect.  Instance-overridable (tests wedge ONE worker of an
+        in-process cluster without touching the process-global latch)."""
+        return devicehealth.backend_wedged(launch=self.workertype == "calc")
+
+    def _debug_snapshot(self, flight_limit=32):
+        """This node's slice of a debug bundle: flight-ring tail, compile
+        registry, device health, runtime versions.  Rides every WRM (small:
+        the tail is capped) so a controller can produce a cross-node
+        artifact even for a worker that has since died."""
+        from bqueryd_tpu.obs import profile
+
+        flight = getattr(self, "flight", None)
+        # NOTE: no histogram snapshot here — the WRM's own "metrics" key
+        # already carries it, and the controller keeps the latest copy per
+        # worker; duplicating it would double every heartbeat's size
+        return {
+            "node_id": getattr(self, "worker_id", None),
+            "workertype": self.workertype,
+            "pid": os.getpid(),
+            "flight": flight.tail(flight_limit) if flight is not None else [],
+            "flight_evictions": (
+                flight.evictions if flight is not None else 0
+            ),
+            "compile": profile.profiler().snapshot(),
+            "device_health": devicehealth.health_snapshot(),
+            "runtime": profile.runtime_versions(),
+            "compile_cache": profile.compile_cache_info(),
+        }
+
+    #: re-send an unchanged debug slice at most this often (covers
+    #: controller restarts, which silently lose absorbed slices) — same
+    #: policy as STATS_READVERTISE_S for shard stats
+    DEBUG_READVERTISE_S = 60.0
+
+    def _debug_change_key(self):
+        """Cheap fingerprint of the debug slice's inputs: flight ring seq,
+        profiler call seq + cache counters, wedge generation."""
+        from bqueryd_tpu.obs import profile
+
+        flight = getattr(self, "flight", None)
+        prof = profile.profiler()
+        return (
+            flight._seq if flight is not None else 0,
+            prof._call_seq,
+            prof.jit_cache_hits,
+            prof.persistent_cache_hits,
+            devicehealth.health_snapshot()["wedge_generation"],
+        )
+
+    def _debug_to_advertise(self):
+        """The debug slice for this WRM, or None when the receiver already
+        has it (unchanged since the last send, inside the re-send window).
+        WRMs fire every <=10 s on two threads; serializing an identical
+        multi-KB snapshot into each would tax every heartbeat for data that
+        changes only on compile/flight/wedge events."""
+        key = self._debug_change_key()
+        now = time.time()
+        if (
+            key == getattr(self, "_debug_sent_key", None)
+            and now - getattr(self, "_debug_sent_ts", 0.0)
+            < self.DEBUG_READVERTISE_S
+        ):
+            return None
+        snapshot = self._debug_snapshot()
+        self._debug_sent_key = key
+        self._debug_sent_ts = now
+        return snapshot
+
+    def _dump_debug_signal(self, *args):
+        from bqueryd_tpu.obs import flightrec, profile
+
+        try:
+            # build_bundle applies the same path redaction the controller's
+            # bundle gets — a worker-side dump must be just as safe to
+            # attach to a public bug report
+            allowed = [self.data_dir]
+            cache_path = profile.compile_cache_info().get("path")
+            if cache_path:
+                allowed.append(cache_path)
+            path = flightrec.dump_bundle(
+                flightrec.build_bundle(
+                    None,
+                    {self.worker_id: {
+                        "data": self._debug_snapshot(flight_limit=512),
+                        "ts": time.time(),
+                        "registered": True,
+                    }},
+                    allowed_path_prefixes=allowed,
+                ),
+                role=self.workertype,
+            )
+            self.logger.warning("SIGUSR1: debug snapshot written to %s", path)
+        except Exception:
+            self.logger.exception("SIGUSR1 debug dump failed")
+
     def prepare_wrm(self):
         # getattr defence: embedders and tests build workers piecemeal
         # (__new__), and a missing registry must never break the WRM
         # heartbeat (same rule as shard_stats)
         registry = getattr(self, "metrics", None)
+        errors = getattr(self, "work_errors", None)
+        try:
+            debug = self._debug_to_advertise()
+        except Exception:
+            debug = None  # a debug failure must never break liveness
         return WorkerRegisterMessage(
             {
                 "worker_id": self.worker_id,
@@ -355,16 +479,16 @@ class WorkerBase:
                 "uptime": time.time() - self.start_time,
                 "msg_count": self.msg_count,
                 # degraded-mode visibility: operators watching rpc.info()
-                # see a wedged accelerator the moment routing does.  CALC
-                # workers own the device, so their heartbeat ticks the
-                # probe clock too — an IDLE wedged worker still recovers
-                # (and stops advertising wedged) without waiting for a
-                # query.  Downloader/move roles never touch the device;
-                # their WRMs read passively so they never spawn jax
-                # probe threads as a side effect
-                "backend_wedged": devicehealth.backend_wedged(
-                    launch=self.workertype == "calc"
-                ),
+                # see a wedged accelerator the moment routing does (and the
+                # controller's health scorer marks this worker "wedged")
+                "backend_wedged": self._backend_wedged(),
+                # error-counter total: the health scorer's windowed error
+                # rate is the delta of this across heartbeats
+                "work_errors": errors.value if errors is not None else 0,
+                # the node's debug-bundle slice (flight tail + compile
+                # registry + device health), absorbed controller-side for
+                # rpc.debug_bundle()
+                "debug": debug,
                 # metadata-only per-shard stats (rows, min/max, cardinality)
                 # feeding the controller's plan-time pruning and kernel-
                 # strategy selection; None for non-calc roles and for beats
@@ -382,6 +506,21 @@ class WorkerBase:
 
     def heartbeat(self):
         now = time.time()
+        # wedge-latch transitions land in the flight ring the moment the
+        # loop notices them (forensic event: never gated by the metrics
+        # kill switch) — the debug bundle's answer to "when did it wedge?"
+        health = devicehealth.health_snapshot()
+        if health["wedge_generation"] != self._wedge_gen_seen:
+            self._wedge_gen_seen = health["wedge_generation"]
+            self.flight.record(
+                "wedge_latched",
+                generation=health["wedge_generation"],
+                abandoned_probes=health["abandoned_probes"],
+            )
+            self.logger.warning(
+                "accelerator backend latched wedged (generation %d)",
+                health["wedge_generation"],
+            )
         interval = self.heartbeat_interval
         # fast start: the first WRM on a freshly connected ROUTER socket is
         # dropped if the peer handshake hasn't finished (identity not yet
@@ -462,6 +601,17 @@ class WorkerBase:
             "trace_id": (wire or {}).get("trace_id"),
             "query_id": msg.get("parent_token") or msg.get("token"),
         }
+        # flight ring: every envelope this worker accepts (hot path — obeys
+        # the metrics kill switch; failures below are recorded regardless)
+        if obs.enabled():
+            self.flight.record(
+                "envelope",
+                verb=msg.get("payload"),
+                token=msg.get("token"),
+                parent=msg.get("parent_token"),
+                trace_id=log_fields["trace_id"],
+            )
+        work_clock = time.perf_counter()
         # correlation ids on every log line this work emits (JSON
         # formatter), and the active TraceContext for trace_span tagging;
         # the except body stays INSIDE the bind — the failure traceback is
@@ -479,11 +629,30 @@ class WorkerBase:
                         f"{-msg.deadline_remaining():.3f}s before execution"
                     )
                 result = self.handle_work(msg)
-            except Exception:
+            except Exception as exc:
                 self.logger.exception("error handling work")
                 self.work_errors.inc()
+                # forensic event (never gated): the first line of the
+                # failure plus its correlation ids — the flight ring is what
+                # explains an ErrorMessage after the query is long gone
+                self.flight.record(
+                    "work_error",
+                    verb=msg.get("payload"),
+                    token=msg.get("token"),
+                    trace_id=log_fields["trace_id"],
+                    error=f"{type(exc).__name__}: {exc}"[:300],
+                )
                 result = ErrorMessage(msg)
                 result["payload"] = traceback.format_exc()
+            else:
+                if obs.enabled():
+                    self.flight.record(
+                        "work_done",
+                        verb=msg.get("payload"),
+                        token=msg.get("token"),
+                        trace_id=log_fields["trace_id"],
+                        wall_s=round(time.perf_counter() - work_clock, 6),
+                    )
         if result is not None:
             try:
                 self.send(sender, result)
@@ -621,6 +790,12 @@ class WorkerNode(WorkerBase):
             "bqueryd_tpu_worker_groupby_seconds",
             "whole-CalcMessage wall on the worker (open to serialize)",
         )
+        # the process-global compile/device profiler exposed on this node's
+        # registry: compile-seconds histogram (same instance process-wide),
+        # jit/persistent-cache counters, HBM watermark gauges
+        from bqueryd_tpu.obs import profile as obs_profile
+
+        obs_profile.profiler().bind(self.metrics)
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
         from bqueryd_tpu import ops
@@ -911,8 +1086,11 @@ class WorkerNode(WorkerBase):
             data = cache.get(cache_key)
             if data is not None:
                 timer.timings["result_cache"] = 0.0
+        mem_tags = None
         if data is None:
             import contextlib
+
+            from bqueryd_tpu.obs import profile as obs_profile
 
             profile_dir = os.environ.get("BQUERYD_TPU_PROFILE_DIR")
             # opt-in: capture a full TensorBoard trace of this query
@@ -922,10 +1100,34 @@ class WorkerNode(WorkerBase):
                 profiling = profiler_trace(profile_dir)
             else:
                 profiling = contextlib.nullcontext()
+            mem_before = obs_profile.profiler().memory_sample()
             with profiling:
                 payload = self._execute(
                     tables, query, timer, strategy=strategy
                 )
+            # the execute above is proof the backend answered: safe to
+            # (lazily) enumerate devices for HBM sampling from now on
+            obs_profile.profiler().note_devices()
+            mem_after = obs_profile.profiler().memory_sample()
+            if mem_after is not None:
+                # device-memory attribution on the calc root span (visible
+                # in rpc.trace waterfalls).  peak_bytes_in_use is the
+                # allocator's PROCESS-LIFETIME watermark, so it is reported
+                # as exactly that; the per-QUERY attribution is the pair of
+                # deltas — how much this query raised the watermark, and
+                # what it added to live device memory
+                before = mem_before or mem_after
+                mem_tags = {
+                    "device_hbm_watermark_bytes":
+                        mem_after["peak_bytes_in_use"],
+                    "device_peak_delta_bytes": (
+                        mem_after["peak_bytes_in_use"]
+                        - before["peak_bytes_in_use"]
+                    ),
+                    "device_bytes_delta": (
+                        mem_after["bytes_in_use"] - before["bytes_in_use"]
+                    ),
+                }
             with timer.phase("serialize"):
                 data = payload.to_bytes()
             if cache is not None and len(data) <= cache.max_bytes // 8:
@@ -942,8 +1144,9 @@ class WorkerNode(WorkerBase):
         reply["phase_timings"] = timer.as_dict()
         if recorder is not None:
             # the span list rides the JSON reply; the controller folds it
-            # into the query timeline behind rpc.trace(trace_id)
-            reply["spans"] = recorder.export()
+            # into the query timeline behind rpc.trace(trace_id); device
+            # memory attribution tags the calc root span
+            reply["spans"] = recorder.export(tags=mem_tags)
             self.groupby_queries.inc()
             self.groupby_seconds.observe(timer.total())
             for phase, seconds in timer.timings.items():
