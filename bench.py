@@ -482,6 +482,9 @@ def main():
             "BQUERYD_TPU_PALLAS",
             "BQUERYD_TPU_FORCE_MATMUL",
             "BQUERYD_TPU_PLANNER",
+            # a pre-pinned pool width would turn the pipeline section's
+            # serialized-vs-pipelined comparison into a self-comparison
+            "BQUERYD_TPU_PIPELINE_THREADS",
         )
     }
     base_dfs = {}  # per-config baseline frames for the variant gates
@@ -1057,6 +1060,191 @@ def main():
                     flush=True,
                 )
 
+        # pipeline: the staged shard pipeline + working-set cache story —
+        # (1) serialized-stage baseline (BQUERYD_TPU_PIPELINE_THREADS=1) vs
+        # the default pipelined wall on the multi-shard headline with COLD
+        # data caches (warm compiled programs: the pipeline overlaps
+        # decode/align/H2D, which warm data caches would skip entirely),
+        # interleaved per repeat; (2) the decode+align+H2D-vs-kernel
+        # overlap ratio from the stage busy clocks bracketing one cold
+        # query; (3) working-set / result / storage-decode cache hit rates;
+        # (4) the codes-cache probe: a warm repeat with a DIFFERENT measure
+        # column must run ZERO factorize calls (align+codes segment hits).
+        pipeline_detail = {}
+        if (
+            os.environ.get("BENCH_PIPELINE", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            from bqueryd_tpu.parallel import pipeline as pipeline_mod
+
+            files, gcols, aggs, where = config_query(HEADLINE, names)
+            try:
+                rpc.groupby(files, gcols, aggs, where)  # warmup
+                ser_walls, pipe_walls = [], []
+                for _ in range(max(REPEATS, 3)):
+                    os.environ["BQUERYD_TPU_PIPELINE_THREADS"] = "1"
+                    try:
+                        _clear_worker_caches(worker)
+                        t0 = time.perf_counter()
+                        rpc.groupby(files, gcols, aggs, where)
+                        ser_walls.append(time.perf_counter() - t0)
+                    finally:
+                        os.environ.pop("BQUERYD_TPU_PIPELINE_THREADS", None)
+                    _clear_worker_caches(worker)
+                    t0 = time.perf_counter()
+                    rpc.groupby(files, gcols, aggs, where)
+                    pipe_walls.append(time.perf_counter() - t0)
+                serialized_wall = min(ser_walls)
+                pipelined_wall = min(pipe_walls)
+
+                # (2) overlap ratio measured over one cold pipelined query
+                pipeline_mod.clock().reset()
+                _clear_worker_caches(worker)
+                t0 = time.perf_counter()
+                rpc.groupby(files, gcols, aggs, where)
+                overlap_wall = time.perf_counter() - t0
+                stages = pipeline_mod.clock().snapshot()
+                busy = stages["busy_seconds"]
+                host_busy = sum(
+                    busy.get(s, 0.0) for s in ("decode", "align", "h2d")
+                )
+                pipeline_detail.update(
+                    {
+                        # honest labeling: THREADS=1 serializes EVERY host
+                        # stage, including the per-shard alignment fan-out
+                        # and depth-2 column overlap that predate the
+                        # unified pipeline — this is the fully-serialized-
+                        # stages baseline (the ISSUE's methodology), not a
+                        # strict before/after of PR 4 alone
+                        "baseline_note": (
+                            "serialized = BQUERYD_TPU_PIPELINE_THREADS=1 "
+                            "(all host stages serial, incl. pre-existing "
+                            "align fan-out)"
+                        ),
+                        "threads_default": pipeline_mod.pipeline_threads(),
+                        "serialized_wall_s": round(serialized_wall, 4),
+                        "pipelined_wall_s": round(pipelined_wall, 4),
+                        "pipeline_speedup": round(
+                            serialized_wall / pipelined_wall, 3
+                        ),
+                        "overlap_wall_s": round(overlap_wall, 4),
+                        "host_stage_busy_s": round(host_busy, 4),
+                        "kernel_busy_s": round(
+                            busy.get("kernel", 0.0), 4
+                        ),
+                        # host-stage busy / wall (the ISSUE's definition).
+                        # Busy sums across pool threads, so a high ratio
+                        # proves CONCURRENT host-stage execution (intra-
+                        # stage fan-out and cross-stage overlap both
+                        # count); the serialized-vs-pipelined walls above
+                        # are what isolate the pipeline's net win.
+                        "overlap_ratio": round(
+                            host_busy / overlap_wall, 4
+                        ) if overlap_wall > 0 else None,
+                        "stage_busy_seconds": {
+                            k: round(v, 4) for k, v in busy.items()
+                        },
+                        "stage_calls": stages["calls"],
+                    }
+                )
+
+                # (4) codes-cache probe: warm repeat, different measure
+                rpc.groupby(files, gcols, aggs, where)  # re-warm caches
+                executor = worker._mesh_executor
+                ws_before = (
+                    executor.workingset.stats() if executor else None
+                )
+                import bqueryd_tpu.ops as ops_mod
+
+                fact_calls = {"n": 0}
+                real_factorize = ops_mod.factorize
+
+                def counting_factorize(*a, **k):
+                    fact_calls["n"] += 1
+                    return real_factorize(*a, **k)
+
+                ops_mod.factorize = counting_factorize
+                try:
+                    t0 = time.perf_counter()
+                    rpc.groupby(
+                        files, gcols,
+                        [["trip_distance", "sum", "dist_sum"]], where,
+                    )
+                    probe_wall = time.perf_counter() - t0
+                finally:
+                    ops_mod.factorize = real_factorize
+                ws_after = (
+                    executor.workingset.stats() if executor else None
+                )
+                pipeline_detail["codes_probe"] = {
+                    "factorize_calls": fact_calls["n"],
+                    "wall_s": round(probe_wall, 4),
+                    "codes_hit": (
+                        ws_after["codes"]["hits"]
+                        - ws_before["codes"]["hits"]
+                        if ws_before else None
+                    ),
+                    "align_hit": (
+                        ws_after["align"]["hits"]
+                        - ws_before["align"]["hits"]
+                        if ws_before else None
+                    ),
+                }
+
+                # (3) cache hit rates at end of run
+                def rates(stats):
+                    total = stats["hits"] + stats["misses"]
+                    return {
+                        **stats,
+                        "hit_rate": (
+                            round(stats["hits"] / total, 4) if total else None
+                        ),
+                    }
+
+                from bqueryd_tpu.storage.ctable import column_cache_stats
+
+                pipeline_detail["caches"] = {
+                    "workingset": (
+                        {
+                            seg: rates(s)
+                            for seg, s in ws_after.items()
+                            if isinstance(s, dict)
+                        }
+                        if ws_after else None
+                    ),
+                    "pressure_evictions": (
+                        ws_after.get("pressure_evictions")
+                        if ws_after else None
+                    ),
+                    "storage_decode": rates(column_cache_stats()),
+                    # the worker result cache is disabled for the bench
+                    # (start_cluster) so repeats measure the engine; its
+                    # counters are recorded anyway for completeness
+                    # (identity check: an EMPTY BytesCappedCache is
+                    # len()-falsy, and False means env-disabled)
+                    "results": (
+                        rates(worker._result_cache.stats())
+                        if worker._result_cache not in (None, False)
+                        else None
+                    ),
+                }
+                print(
+                    f"[bench] pipeline: serialized {serialized_wall:.3f}s "
+                    f"vs pipelined {pipelined_wall:.3f}s "
+                    f"({serialized_wall / pipelined_wall:.2f}x), overlap "
+                    f"ratio {pipeline_detail['overlap_ratio']}, codes "
+                    f"probe {pipeline_detail['codes_probe']}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as exc:
+                print(
+                    f"[bench] pipeline section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         if HEADLINE in completed:
             head_name = HEADLINE
         elif completed:
@@ -1110,6 +1298,10 @@ def main():
             # compile-cache hit rates + the per-shape program registry with
             # cost_analysis FLOPs (obs.profile)
             "profiling": profiling_detail,
+            # serialized-vs-pipelined walls, stage busy clocks + overlap
+            # ratio, working-set / storage / result cache hit rates, and
+            # the zero-factorize codes-cache probe
+            "pipeline": pipeline_detail,
             "total_s": round(time.time() - t_start, 1),
         }
         with open(detail_path, "w") as f:
@@ -1157,6 +1349,12 @@ def main():
                             "plan_counters", {}
                         ).get("plan_pruned_shards"),
                         "obs_overhead_pct": obs_detail.get("overhead_pct"),
+                        "pipeline_speedup": pipeline_detail.get(
+                            "pipeline_speedup"
+                        ),
+                        "pipeline_overlap_ratio": pipeline_detail.get(
+                            "overlap_ratio"
+                        ),
                         "jit_cache_hit_rate": profiling_detail.get(
                             "jit_cache_hit_rate"
                         ),

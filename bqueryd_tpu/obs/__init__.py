@@ -26,6 +26,14 @@ PR 3 adds the forensic/feedback tier:
   ok/degraded/wedged behind ``rpc.health()``, fed back into dispatch
   affinity (degraded workers are deprioritized, never excluded).
 
+PR 4 widens the worker surface with the shard-pipeline and working-set
+cache families: ``bqueryd_tpu_pipeline_busy_seconds{stage=...}`` (per-stage
+busy clocks from :mod:`bqueryd_tpu.parallel.pipeline` — busy sum > wall
+proves stage overlap), ``bqueryd_tpu_workingset_*{segment=...}`` +
+``bqueryd_tpu_result_cache_*`` (LRU cache hit/miss/eviction counters from
+:mod:`bqueryd_tpu.ops.workingset`), and the HBM-pressure shed counter
+``bqueryd_tpu_workingset_pressure_evictions``.
+
 The hot path (span recording + histogram observes + flight envelope events
 + compile-call accounting) can be disabled with ``BQUERYD_TPU_METRICS=0``
 (or :func:`set_enabled`) — bench.py measures the enabled-vs-disabled delta

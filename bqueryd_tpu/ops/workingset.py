@@ -1,0 +1,166 @@
+"""Device-resident working-set cache: codes + measure blocks + alignment.
+
+The executor's steady-state serving story is cache residency: host key
+alignment (dictionary-sized), factorized+folded group codes (HBM), and
+wire-dtype measure blocks (HBM).  Before this module those were three
+ad-hoc ``BytesCappedCache`` instances with wholesale eviction and no
+telemetry; this promotes them into one named working-set layer:
+
+* **content-keyed segments** — ``align`` (host: dense codes + global
+  dictionaries per (table set, groupby columns)), ``codes`` (device:
+  packed+folded group codes per (table set, groupby columns, filter)),
+  ``blocks`` (device: packed wire-dtype measure columns per (table set,
+  column)).  Keys carry the shard identity (rootdir + meta.json
+  inode/mtime + rows, :func:`bqueryd_tpu.storage.ctable.table_cache_key`),
+  so activation invalidates naturally and a repeat query with a DIFFERENT
+  measure or filter still hits the codes/alignment segments — it skips
+  decode, factorize and the codes H2D entirely instead of requiring an
+  exact serialized-result hit.
+* **LRU byte budgets per segment** (see the env vars below), with
+  hit/miss/eviction counters exported as worker gauges
+  (``bqueryd_tpu_workingset_*{segment=...}``) and into bench.py's
+  ``pipeline`` section.
+* **eviction under device-memory pressure** —
+  :meth:`WorkingSet.evict_under_pressure` reads the PR-3 HBM watermark
+  sample (``obs.profile.profiler().memory_sample()``) and evicts LRU
+  device entries until usage projects below
+  ``BQUERYD_TPU_HBM_EVICT_WATERMARK`` x ``bytes_limit`` — shedding cache
+  before the allocator hits RESOURCE_EXHAUSTED and ``DeviceHealth``
+  latches the backend as wedged.
+
+A :class:`WorkingSet` is per-executor (the worker owns one mesh executor),
+not process-global: in-process test clusters and bench workers must not
+bleed cached device blocks into each other, same per-node rule as the
+metrics registries.
+"""
+
+import os
+
+from bqueryd_tpu.utils.cache import BytesCappedCache
+
+#: segments holding DEVICE buffers, in memory-pressure eviction order —
+#: blocks first: they are the biggest and the cheapest to rebuild from the
+#: still-cached host alignment
+DEVICE_SEGMENTS = ("blocks", "codes")
+
+#: every segment, in eviction-preference order (device blocks first: they
+#: are the biggest and the cheapest to rebuild from the still-cached host
+#: alignment)
+SEGMENTS = ("blocks", "codes", "align")
+
+_DEFAULT_BUDGETS = {
+    # host alignment cache (dense codes + combos + dictionaries)
+    "align": ("BQUERYD_TPU_ALIGN_CACHE_BYTES", 512 * 1024**2),
+    # HBM folded group codes (one entry per (table set, keys, filter))
+    "codes": ("BQUERYD_TPU_CODES_CACHE_BYTES", 256 * 1024**2),
+    # HBM packed measure blocks (one entry per (table set, column))
+    "blocks": ("BQUERYD_TPU_HBM_CACHE_BYTES", 1024 * 1024**2),
+}
+
+
+def _budget(segment):
+    env, default = _DEFAULT_BUDGETS[segment]
+    try:
+        return int(os.environ.get(env, default))
+    except ValueError:
+        import logging
+
+        logging.getLogger("bqueryd_tpu").warning(
+            "unparseable %s, using default %d", env, default
+        )
+        return default
+
+
+def evict_watermark():
+    """Fraction of ``bytes_limit`` above which device cache is shed
+    (``BQUERYD_TPU_HBM_EVICT_WATERMARK``, default 0.9; <=0 disables)."""
+    try:
+        return float(os.environ.get("BQUERYD_TPU_HBM_EVICT_WATERMARK", 0.9))
+    except ValueError:
+        return 0.9
+
+
+def _device_nbytes(value):
+    """Accounted size of a device array (jax.Array exposes nbytes)."""
+    return getattr(value, "nbytes", 0)
+
+
+class WorkingSet:
+    """Named LRU cache segments + the device-memory-pressure eviction policy
+    (module docstring)."""
+
+    def __init__(self, budgets=None):
+        import threading
+
+        budgets = budgets or {}
+        self._segments = {
+            name: BytesCappedCache(
+                budgets.get(name, _budget(name)), sizeof=_device_nbytes
+            )
+            for name in SEGMENTS
+        }
+        self.pressure_evictions = 0  # entries shed by the watermark policy
+        self._pressure_lock = threading.Lock()
+
+    def segment(self, name):
+        return self._segments[name]
+
+    def clear(self):
+        for cache in self._segments.values():
+            cache.clear()
+
+    def stats(self):
+        """Per-segment counters + the pressure-eviction total (JSON-safe,
+        feeds the worker gauges and bench's ``pipeline`` section)."""
+        out = {
+            name: cache.stats() for name, cache in self._segments.items()
+        }
+        out["pressure_evictions"] = self.pressure_evictions
+        return out
+
+    # -- memory pressure -----------------------------------------------------
+    def evict_under_pressure(self, sample=None, watermark=None):
+        """Shed LRU device-segment entries while HBM usage sits above the
+        watermark.  ``sample`` is a ``{"bytes_in_use", "bytes_limit", ...}``
+        dict (default: the live profiler sample; None — CPU backends,
+        unproven tunnels — is a no-op).  Returns bytes freed (accounted
+        cache bytes, a proxy for the HBM the dropped references release at
+        the allocator's next sweep).
+
+        Eviction order is ``blocks`` before ``codes``: measure blocks are
+        the bulk of residency and rebuild from the still-cached host
+        alignment with one decode+H2D, while codes rebuilding also re-runs
+        mask folding."""
+        if watermark is None:
+            watermark = evict_watermark()
+        if watermark <= 0:
+            return 0
+        if sample is None:
+            from bqueryd_tpu.obs import profile
+
+            sample = profile.profiler().memory_sample()
+        if not sample:
+            return 0
+        limit = sample.get("bytes_limit") or 0
+        in_use = sample.get("bytes_in_use") or 0
+        if limit <= 0 or in_use <= watermark * limit:
+            return 0
+        target = int(in_use - watermark * limit)
+        freed = 0
+        for name in DEVICE_SEGMENTS:
+            cache = self._segments[name]
+            seg_freed, seg_count = cache.evict_bytes(target - freed)
+            freed += seg_freed
+            with self._pressure_lock:
+                self.pressure_evictions += seg_count
+            if freed >= target:
+                break
+        if freed:
+            import logging
+
+            logging.getLogger("bqueryd_tpu").info(
+                "HBM watermark pressure: shed %d cached device bytes "
+                "(in_use %d > %.0f%% of limit %d)",
+                freed, in_use, watermark * 100, limit,
+            )
+        return freed

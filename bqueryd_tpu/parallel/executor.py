@@ -28,12 +28,24 @@ raw-rows path through the per-shard ``QueryEngine`` + host merge instead —
 those results carry value *sets*, which a fixed-width psum cannot merge.
 
 Steady-state serving is cache-resident (the TPU analogue of bquery's
-``auto_cache`` factorization cache, reference bqueryd/worker.py:291): the
-host-side key alignment is cached per (table-set, groupby-cols), and the
-packed device blocks — group codes and measure columns — stay HBM-resident
-keyed by table identity (rootdir + mtime, so shard activation invalidates
-naturally).  A repeated query therefore skips decode, factorize, alignment
-and H2D entirely and costs one compiled kernel dispatch.
+``auto_cache`` factorization cache, reference bqueryd/worker.py:291),
+organized as the working-set layer in :mod:`bqueryd_tpu.ops.workingset`:
+host-side key alignment cached per (table-set, groupby-cols), and the
+packed device blocks — group codes and measure columns — HBM-resident in
+LRU byte-budgeted segments keyed by table identity (rootdir + mtime, so
+shard activation invalidates naturally).  A repeated query — including one
+with a DIFFERENT measure column, aggregate op or filter — therefore skips
+decode, factorize, alignment and (for codes) H2D, and costs one compiled
+kernel dispatch; under HBM pressure the working set sheds LRU device
+entries before the allocator can wedge.
+
+The cold path is a staged pipeline on the bounded pool in
+:mod:`bqueryd_tpu.parallel.pipeline`: storage decode of cache-missing
+measure columns is prefetched while key alignment runs, per-shard
+decode/factorize fans out on the same pool, and the column build loop
+keeps one decode+pack in flight ahead of each H2D transfer — stage busy
+clocks feed the ``bqueryd_tpu_pipeline_busy_seconds`` gauges and bench.py's
+overlap ratio.
 """
 
 import functools
@@ -179,46 +191,44 @@ class MeshQueryExecutor:
         self.axis_name = axis_name
         self.timer = timer
         self._align_engine = None
-        from bqueryd_tpu.utils.cache import BytesCappedCache
+        from bqueryd_tpu.ops.workingset import WorkingSet
 
-        # host alignment cache: (tables_key, groupby_cols) ->
-        #   (dense codes per shard, combos, cards, key_values)
-        self._align_cache = BytesCappedCache(
-            int(os.environ.get("BQUERYD_TPU_ALIGN_CACHE_BYTES", 512 * 1024**2))
-        )
-        # HBM-resident packed blocks: cache key -> jax.Array [n_dev, width].
-        # On CPU/tunneled backends these buffers count against host RSS, so
-        # the default stays well under the worker's 2 GB restart threshold
-        # (the watchdog clears this cache before giving up, worker._check_mem)
-        self._hbm_cache = BytesCappedCache(
-            int(os.environ.get("BQUERYD_TPU_HBM_CACHE_BYTES", 1024 * 1024**2))
-        )
+        # the device-resident working-set layer (ops/workingset.py): LRU
+        # byte-budgeted segments with hit/miss/eviction telemetry and
+        # HBM-watermark pressure eviction.
+        #   align:  (tables_key, groupby_cols) -> (dense codes per shard,
+        #           combos, cards, key_values) — host side
+        #   codes:  folded+packed group codes -> jax.Array [n_dev, width]
+        #   blocks: packed wire-dtype measure columns -> jax.Array
+        # On CPU/tunneled backends the device segments count against host
+        # RSS, so the defaults stay well under the worker's 2 GB restart
+        # threshold (the watchdog clears them before giving up,
+        # worker._check_mem)
+        self.workingset = WorkingSet()
+        self._align_cache = self.workingset.segment("align")
+        self._hbm_cache = self.workingset.segment("blocks")
+        self._codes_cache = self.workingset.segment("codes")
 
     def clear_caches(self):
-        """Drop host alignment + HBM block caches (memory-watchdog hook)."""
-        self._align_cache.clear()
-        self._hbm_cache.clear()
+        """Drop host alignment + HBM working-set segments (memory-watchdog
+        hook)."""
+        self.workingset.clear()
         if self._align_engine is not None:
             self._align_engine.clear_caches()
 
     @staticmethod
     def _map_shards(fn, items):
-        """Map ``fn`` over shards on a short-lived thread pool (the
+        """Map ``fn`` over shards on the shared pipeline pool (the
         decode/factorize/np work dominating cold alignment releases the
-        GIL); sequential for single shards or under BQUERYD_TPU_ALIGN_THREADS=1."""
-        items = list(items)
-        workers = int(
-            os.environ.get(
-                "BQUERYD_TPU_ALIGN_THREADS",
-                min(len(items), os.cpu_count() or 4, 16),
-            )
-        )
-        if len(items) <= 1 or workers <= 1:
-            return [fn(it) for it in items]
-        from concurrent.futures import ThreadPoolExecutor
+        GIL); sequential for single shards or one-thread pipelines.
+        BQUERYD_TPU_ALIGN_THREADS caps the alignment fan-out specifically;
+        BQUERYD_TPU_PIPELINE_THREADS sizes the pool itself."""
+        from bqueryd_tpu.parallel import pipeline
 
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            return list(pool.map(fn, items))
+        items = list(items)
+        cap = os.environ.get("BQUERYD_TPU_ALIGN_THREADS")
+        max_workers = int(cap) if cap is not None else len(items)
+        return pipeline.map_ordered(fn, items, max_workers=max_workers)
 
     def _engine(self):
         """The engine used for alignment/key factorization — persistent so
@@ -511,6 +521,8 @@ class MeshQueryExecutor:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from bqueryd_tpu.parallel import pipeline
+
         tables_key = tuple(_table_key(t) for t in tables)
         cols_key = tuple(query.groupby_cols)
         mesh = self.mesh
@@ -520,7 +532,48 @@ class MeshQueryExecutor:
             tables_key, "codes", cols_key, _where_signature(query), n_dev,
         )
 
-        with self._phase("align"):
+        # fused multi-agg gather: sum+count+mean over the same column pack,
+        # upload and feed ONE device block; measure_index maps each agg
+        # back to its slot inside the compiled program, so codes are
+        # gathered against each distinct column exactly once
+        unique_cols = list(dict.fromkeys(query.in_cols))
+        measure_index = tuple(
+            unique_cols.index(col) for col in query.in_cols
+        )
+        missing_cols = [
+            col for col in unique_cols
+            if (tables_key, "col", col, n_dev) not in self._hbm_cache
+        ]
+        align_warm = (tables_key, cols_key) in self._align_cache
+        codes_warm = codes_key in self._codes_cache
+
+        # shed LRU device cache BEFORE this query adds residency, while the
+        # PR-3 HBM watermark sample still reflects the previous steady state
+        # (evicting after the allocation failed would be a wedge, not a
+        # plan).  Cold branches only: a fully-warm query adds nothing, and
+        # the memory sample costs a device.memory_stats() round-trip that
+        # must never tax steady-state latency — nor may the shed run before
+        # a warm query's gets refresh their entries' recency.
+        if missing_cols or not codes_warm:
+            self.workingset.evict_under_pressure()
+
+        # chunk-decode prefetch (pipeline stage 1): fire storage decode of
+        # the cache-missing measure columns on the pipeline pool NOW, so
+        # decode overlaps the mask/fold + codes-H2D work below.  Skipped
+        # when the ALIGNMENT is cold: align's own per-shard fan-out needs
+        # the pool, and a FIFO pool would drain these decode jobs first,
+        # serializing decode ahead of align instead of overlapping either.
+        prefetch = {}
+        if align_warm and pipeline.pipeline_threads() > 1:
+            for col in missing_cols:
+                futs = []
+                for t in tables:
+                    warm = getattr(t, "prefetch", None)
+                    if warm is not None:
+                        futs.extend(warm([col]))
+                prefetch[col] = futs
+
+        with self._phase("align"), pipeline.stage("align"):
             cached = self._align_cache.get((tables_key, cols_key))
             if cached is None:
                 dense, combos, cards, key_values = self._global_key_space(
@@ -537,7 +590,7 @@ class MeshQueryExecutor:
                 dense, combos, cards, key_values = cached
             n_groups = max(len(combos), 1)
 
-        codes_d = self._hbm_cache.get(codes_key)
+        codes_d = self._codes_cache.get(codes_key)
         if codes_d is None:
             # cold path only: masks + fold + pack + H2D.  On a cache hit the
             # whole filter evaluation is skipped — the folded codes ARE the
@@ -559,72 +612,81 @@ class MeshQueryExecutor:
                 # fold the row mask into the codes: masked-out rows become
                 # null (code -1) and vanish from every segment reduction.
                 # Folds into fresh arrays — cached dense stays unmasked.
-                cdt = _codes_dtype(n_groups)
-                folded = [
-                    np.where(mask, d, -1).astype(cdt)
-                    if mask is not None
-                    else d.astype(cdt)
-                    for d, mask in zip(dense, masks)
-                ]
-                packed = self._pack(folded, n_dev, cdt.type(-1), dtype=cdt)
-                codes_d = _put(packed, sharding)
-                self._hbm_cache.put(codes_key, codes_d)
+                with pipeline.stage("align"):
+                    cdt = _codes_dtype(n_groups)
+                    folded = [
+                        np.where(mask, d, -1).astype(cdt)
+                        if mask is not None
+                        else d.astype(cdt)
+                        for d, mask in zip(dense, masks)
+                    ]
+                    packed = self._pack(
+                        folded, n_dev, cdt.type(-1), dtype=cdt
+                    )
+                with pipeline.stage("h2d"):
+                    codes_d = _put(packed, sharding)
+                self._codes_cache.put(codes_key, codes_d)
 
         with self._phase("layout"):
             def build_packed(col):
-                # decode (C++ chunk threads, GIL released) + narrow + pack
-                wire = _wire_dtype(tables, col) or _stored_dtype(tables, col)
-                cols = [np.asarray(t.column_raw(col)) for t in tables]
-                if wire is not None:
-                    cols = [c.astype(wire, copy=False) for c in cols]
-                return self._pack(cols, n_dev, 0, dtype=wire)
+                # wait for this column's prefetched decodes first: they
+                # populate the storage cache, and racing a duplicate decode
+                # here would burn the cores the pipeline is trying to share
+                for fut in prefetch.get(col, ()):
+                    fut.result()
+                with pipeline.stage("decode"):
+                    # decode (C++ chunk threads, GIL released) + narrow +
+                    # pack into the [n_dev, width] device layout
+                    wire = (
+                        _wire_dtype(tables, col)
+                        or _stored_dtype(tables, col)
+                    )
+                    cols = [np.asarray(t.column_raw(col)) for t in tables]
+                    if wire is not None:
+                        cols = [c.astype(wire, copy=False) for c in cols]
+                    return self._pack(cols, n_dev, 0, dtype=wire)
 
             # cold path with several columns: overlap the NEXT column's
             # decode+pack with the CURRENT column's host->device transfer
             # (the two dominate cold latency and use disjoint resources)
             missing = [
                 col
-                for col in query.in_cols
-                if self._hbm_cache.get((tables_key, "col", col, n_dev))
-                is None
+                for col in unique_cols
+                if (tables_key, "col", col, n_dev) not in self._hbm_cache
             ]
             futures = {}
-            pool = None
+            use_pool = len(missing) > 1 and pipeline.pipeline_threads() > 1
             missing_iter = iter(missing)
 
             def submit_next():
                 for c in missing_iter:
-                    futures[c] = pool.submit(build_packed, c)
+                    futures[c] = pipeline.submit(build_packed, c)
                     return
 
-            if len(missing) > 1:
-                from concurrent.futures import ThreadPoolExecutor
-
-                pool = ThreadPoolExecutor(max_workers=1)
-                # depth-2 pipeline: one build in flight ahead of the put
-                # loop, the next submitted as each completes — peak host
-                # residency stays ~2 packed columns however many are missing
+            if use_pool:
+                # prime ONE build ahead of the put loop; the next is
+                # submitted as each is consumed — exactly one build in
+                # flight plus the column being uploaded, so peak host
+                # residency stays ~2 packed columns however many are
+                # missing (priming two would run both concurrently on the
+                # shared pool: ~3 resident)
                 submit_next()
-                submit_next()
-            try:
-                measures_d = []
-                for col in query.in_cols:
-                    mkey = (tables_key, "col", col, n_dev)
-                    arr = self._hbm_cache.get(mkey)
-                    if arr is None:
-                        if col in futures:
-                            packed = futures.pop(col).result()
-                            submit_next()
-                        else:
-                            packed = build_packed(col)
+            measures_d = []
+            for col in unique_cols:
+                mkey = (tables_key, "col", col, n_dev)
+                arr = self._hbm_cache.get(mkey)
+                if arr is None:
+                    if col in futures:
+                        packed = futures.pop(col).result()
+                        submit_next()
+                    else:
+                        packed = build_packed(col)
+                    with pipeline.stage("h2d"):
                         arr = _put(packed, sharding)
-                        self._hbm_cache.put(mkey, arr)
-                    measures_d.append(arr)
-            finally:
-                if pool is not None:
-                    pool.shutdown(wait=True)
+                    self._hbm_cache.put(mkey, arr)
+                measures_d.append(arr)
 
-        with self._phase("aggregate"):
+        with self._phase("aggregate"), pipeline.stage("kernel"):
             sentinels = tuple(
                 np.iinfo(np.int64).min if k == "datetime" else None
                 for k in measure_kinds
@@ -649,6 +711,7 @@ class MeshQueryExecutor:
                         codes_d, tuple(measures_d),
                         null_sentinels=sentinels,
                         strategy=strategy,
+                        measure_index=measure_index,
                     )
                     break
                 except jax.errors.JaxRuntimeError as exc:
@@ -666,7 +729,7 @@ class MeshQueryExecutor:
                     lambda a: a[:n_groups], merged
                 )
 
-        with self._phase("collect"):
+        with self._phase("collect"), pipeline.stage("merge"):
             rows = merged["rows"]
             present = rows > 0
             combos_present = combos[present]
@@ -784,7 +847,8 @@ def _shard_map(fn, mesh, in_specs, out_specs, check):
 
 @functools.lru_cache(maxsize=64)
 def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
-                  null_sentinels=None, route=None, strategy=None):
+                  null_sentinels=None, route=None, strategy=None,
+                  measure_index=None):
     """Build + cache the jitted shard_map program for one query shape.
 
     The key carries everything that can change the traced program — measure
@@ -792,7 +856,10 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
     output's host-side unpack spec is captured at trace time, and both leaf
     dtypes (via the measure dtypes) and the kernel route (via the row count,
     ``_matmul_cells_limit``, and the ``route`` flag tuple) feed it, so one
-    cache entry must map to exactly one trace."""
+    cache entry must map to exactly one trace.  ``measure_index`` (static)
+    maps each aggregation to its slot in the DEDUPLICATED measure blocks:
+    ``sum+count+mean`` of one column ride one uploaded block and one
+    program argument instead of three."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -801,9 +868,15 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
     spec = {}  # populated at trace time: treedef + (dtype, shape) per leaf
 
     def block_fn(codes_blk, *measure_blks):
+        per_block = tuple(m[0] for m in measure_blks)
+        per_agg = (
+            per_block
+            if measure_index is None
+            else tuple(per_block[i] for i in measure_index)
+        )
         partials = ops.partial_tables(
             codes_blk[0],
-            tuple(m[0] for m in measure_blks),
+            per_agg,
             agg_ops,
             n_groups,
             null_sentinels=null_sentinels,
@@ -916,15 +989,22 @@ def _collective_guard():
 
 
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
-                   null_sentinels=None, strategy=None):
+                   null_sentinels=None, strategy=None, measure_index=None):
     """Run the mesh program and return the merged partials pytree ON HOST
-    (numpy leaves) — fetching one packed buffer when packing is enabled."""
+    (numpy leaves) — fetching one packed buffer when packing is enabled.
+    ``measures_d`` holds one device block per DISTINCT measure column;
+    ``measure_index`` maps each agg onto those slots (None = identity)."""
     global _packed_fetch_broken
     import jax
 
     pack = packed_fetch_enabled() and not _packed_fetch_broken
+    per_agg_measures = (
+        measures_d
+        if measure_index is None
+        else tuple(measures_d[i] for i in measure_index)
+    )
     strategy = _effective_mesh_strategy(
-        strategy, tuple(agg_ops), n_groups, measures_d,
+        strategy, tuple(agg_ops), n_groups, per_agg_measures,
         int(codes_d.shape[1]),
     )
     in_dtypes = (str(codes_d.dtype),) + tuple(str(m.dtype) for m in measures_d)
@@ -936,6 +1016,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             null_sentinels,  # part of the lru key: it changes the trace
             route=_route_key(),  # ditto: the flags steer the traced route
             strategy=strategy,  # planner hint: a different traced route too
+            measure_index=measure_index,  # agg -> deduped block slot
         )
 
     global _packed_transient_count

@@ -1,0 +1,211 @@
+"""Bounded shard-stage pipeline: one shared thread pool + stage accounting.
+
+The per-shard data path is a sequence of host stages feeding one device
+stage — storage decode -> factorize/align -> H2D ``device_put`` -> kernel
+dispatch.  JAX's async dispatch already overlaps the device side for free;
+what the serial code paths never exploited is that the HOST stages of shard
+(or column) *i+1* can run while the device computes on *i*.  This module is
+the shared substrate both exploit sites use:
+
+* :func:`map_ordered` — run a stage function over shards on the bounded
+  pool, results in input order (the contract
+  ``hostmerge.merge_payloads`` and the mesh alignment both rely on);
+* :func:`submit` / :func:`pool` — double-buffering seams (the executor
+  keeps one column build in flight ahead of its H2D loop, and prefetches
+  storage decode while alignment runs);
+* :func:`stage` — wall-clock busy accounting per stage name (thread-safe,
+  process-global).  Busy time sums across all pool threads, so a busy/wall
+  ratio above the serial share proves CONCURRENT execution of stage work —
+  intra-stage fan-out and cross-stage overlap both count; the clocks cannot
+  distinguish the two.  bench.py's ``pipeline`` section reports the ratio
+  (``overlap_ratio = host busy / wall``, the ISSUE's definition) alongside
+  the serialized-vs-pipelined walls, which is the measurement that actually
+  isolates what the pipeline buys; workers export the same clocks as
+  ``bqueryd_tpu_pipeline_busy_seconds`` gauges.
+
+One pool per process, sized by ``BQUERYD_TPU_PIPELINE_THREADS`` (default
+``min(16, cpu)``; ``1`` serializes every stage — the bench's
+serialized-stage baseline).  The env var is read per call and the pool transparently rebuilt
+on a size change, so a live worker can be re-tuned (and the bench can
+compare 1 vs default in one process) without restarts.  All stage work is
+host-side decode/factorize/NumPy/H2D — the C++ chunk decode and numpy
+release the GIL, so the pool scales on real cores without fighting the
+interpreter.
+"""
+
+import contextlib
+import os
+import threading
+import time
+
+#: stages the busy clocks track (fixed so the worker can register one gauge
+#: per stage up front; unknown names still accumulate, they just aren't
+#: exported as metrics until added here)
+STAGES = ("decode", "align", "h2d", "kernel", "merge")
+
+#: matches the pre-pipeline alignment fan-out ceiling (the old _map_shards
+#: capped at 16): the shared pool must not narrow cold alignment on big hosts
+_DEFAULT_THREADS = min(16, os.cpu_count() or 4)
+
+
+def pipeline_threads():
+    """Pool width from ``BQUERYD_TPU_PIPELINE_THREADS`` (default
+    ``min(16, cpu)``); 1 disables every pipeline overlap (serial stages),
+    0/negative and unparseable values fall back to the default."""
+    raw = os.environ.get("BQUERYD_TPU_PIPELINE_THREADS")
+    if raw is None:
+        return _DEFAULT_THREADS
+    try:
+        n = int(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger("bqueryd_tpu").warning(
+            "unparseable BQUERYD_TPU_PIPELINE_THREADS=%r, using default %d",
+            raw, _DEFAULT_THREADS,
+        )
+        return _DEFAULT_THREADS
+    return n if n >= 1 else _DEFAULT_THREADS
+
+
+_pool_lock = threading.Lock()
+_pool = None
+_pool_width = None
+
+
+def pool():
+    """The process-wide pipeline ThreadPoolExecutor, (re)built to the
+    current ``pipeline_threads()`` width.
+
+    A replaced pool is NOT shut down: an in-flight ``map_ordered`` may
+    still submit to it, and ``shutdown()`` would make that submit raise
+    mid-query.  Its idle threads cost only memory until process exit
+    (interpreter shutdown wakes and joins them), and resizes are rare
+    operator events — width 1 never builds a pool at all, so the common
+    serial<->default toggle leaks nothing."""
+    global _pool, _pool_width
+    width = pipeline_threads()
+    with _pool_lock:
+        if _pool is None or _pool_width != width:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _pool = ThreadPoolExecutor(
+                max_workers=width, thread_name_prefix="bq-pipeline"
+            )
+            _pool_width = width
+        return _pool
+
+
+def submit(fn, *args, **kwargs):
+    """Submit one stage job; serial fallback (immediate call wrapped in a
+    completed future) when the pipeline is pinned to one thread, so callers
+    never build a one-thread pool just to preserve their code shape."""
+    if pipeline_threads() <= 1:
+        from concurrent.futures import Future
+
+        f = Future()
+        try:
+            f.set_result(fn(*args, **kwargs))
+        except BaseException as exc:  # Future carries it to .result()
+            f.set_exception(exc)
+        return f
+    return pool().submit(fn, *args, **kwargs)
+
+
+def map_ordered(fn, items, max_workers=None):
+    """Map ``fn`` over ``items`` on the pipeline pool, returning results in
+    input order (the deterministic-payload contract).  Runs serially when
+    the effective width or the item count is 1.  ``max_workers`` only caps
+    concurrency-in-flight; the shared pool itself is never resized here."""
+    items = list(items)
+    width = pipeline_threads()
+    if max_workers is not None:
+        width = min(width, int(max_workers))
+    if len(items) <= 1 or width <= 1:
+        return [fn(it) for it in items]
+    # bound in-flight jobs to the effective width so one giant fan-out
+    # cannot monopolize the shared pool against other queries' stages:
+    # prime a window of `width` submissions, then collect sequentially,
+    # launching the next item as each result is taken
+    futures = {}
+    results = [None] * len(items)
+    next_idx = iter(range(len(items)))
+    executor = pool()
+
+    def launch():
+        for i in next_idx:
+            futures[i] = executor.submit(fn, items[i])
+            return
+
+    for _ in range(min(width, len(items))):
+        launch()
+    try:
+        for i in range(len(items)):
+            results[i] = futures.pop(i).result()
+            launch()
+    except BaseException:
+        # the query already failed: still-queued shards must not burn the
+        # SHARED pool against other queries (already-running ones finish —
+        # cancel() cannot interrupt them)
+        for fut in futures.values():
+            fut.cancel()
+        raise
+    return results
+
+
+class StageClock:
+    """Thread-safe per-stage busy-seconds + call counts (process-global).
+
+    Busy time is the SUM of wall time spent inside each stage across all
+    threads — under overlap it legitimately exceeds the query wall, which is
+    the measurement: ``overlap = busy(host stages) / wall`` > the serial
+    share proves stages ran concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy = {}    # stage -> seconds
+        self._calls = {}   # stage -> count
+
+    def add(self, stage_name, seconds):
+        with self._lock:
+            self._busy[stage_name] = (
+                self._busy.get(stage_name, 0.0) + float(seconds)
+            )
+            self._calls[stage_name] = self._calls.get(stage_name, 0) + 1
+
+    def busy_seconds(self, stage_name):
+        with self._lock:
+            return self._busy.get(stage_name, 0.0)
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "busy_seconds": dict(self._busy),
+                "calls": dict(self._calls),
+            }
+
+    def reset(self):
+        """Bench/test seam: zero the clocks for a bracketed measurement."""
+        with self._lock:
+            self._busy.clear()
+            self._calls.clear()
+
+
+_clock = StageClock()
+
+
+def clock():
+    """The process-global :class:`StageClock`."""
+    return _clock
+
+
+@contextlib.contextmanager
+def stage(name):
+    """Time one stage occurrence into the global clock (always on — two
+    dict updates under a lock per stage, far below the metrics hot-path
+    budget; the obs kill switch gates span recording, not this)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _clock.add(name, time.perf_counter() - t0)

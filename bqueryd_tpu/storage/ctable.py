@@ -115,6 +115,12 @@ def free_cachemem():
     _COLUMN_CACHE.clear()
 
 
+def column_cache_stats():
+    """Decoded-column cache counters (hits/misses/evictions/bytes) — feeds
+    the bench ``pipeline`` section's storage-decode hit rate."""
+    return _COLUMN_CACHE.stats()
+
+
 def _cache_get(key):
     return _COLUMN_CACHE.get(key)
 
@@ -430,6 +436,30 @@ class ctable:
             out.setflags(write=False)
             _cache_put(key, out)
         return out
+
+    def prefetch(self, names, submit=None):
+        """Warm the decoded-column cache for ``names`` — the chunk-decode
+        prefetch stage of the shard pipeline: the executor submits these on
+        the pipeline pool so storage decode of the NEXT query inputs
+        overlaps alignment/kernel work instead of serializing in front of
+        the H2D loop.  ``submit`` is a ``fn -> Future`` scheduler (default:
+        the shared pipeline pool); returns the futures (callers that must
+        have the bytes wait on them, everyone else just lets the cache
+        absorb the result)."""
+        if submit is None:
+            from bqueryd_tpu.parallel import pipeline
+
+            submit = pipeline.submit
+
+        def decode(name):
+            from bqueryd_tpu.parallel import pipeline
+
+            with pipeline.stage("decode"):
+                return self.column_raw(name)
+
+        return [
+            submit(decode, name) for name in names if name in self._columns
+        ]
 
     def column(self, name):
         """Logical column values: strings decoded from the dictionary,

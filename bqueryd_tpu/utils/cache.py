@@ -4,41 +4,105 @@ import threading
 
 
 class BytesCappedCache:
-    """Dict-shaped cache with a byte budget and wholesale eviction.
+    """Dict-shaped cache with a byte budget and LRU segment eviction.
 
-    Wholesale (clear-everything) eviction is deliberate: entries are
-    query-working-set artifacts that re-warm in one pass, and tracking LRU
-    order costs more than re-warming does.  The in-memory analogue of
-    bquery's auto_cache policy (reference bqueryd/worker.py:291,330).
-    Thread-safe: workers share one instance across request threads.
+    Entries evict least-recently-used-first, one at a time, until the new
+    entry fits — the in-memory analogue of bquery's auto_cache policy
+    (reference bqueryd/worker.py:291,330), upgraded from the original
+    wholesale clear: a working set larger than one entry no longer loses
+    everything when a single insert tips the budget, and an entry larger
+    than the whole budget is REJECTED instead of being inserted into a
+    permanently over-budget cache.
+
+    ``get`` refreshes recency.  Hit/miss/eviction/rejection counts are
+    exposed for the working-set metrics (:mod:`bqueryd_tpu.ops.workingset`)
+    and the bench's cache-hit-rate section.  Thread-safe: workers share one
+    instance across request threads.
     """
 
     def __init__(self, max_bytes, sizeof=lambda v: v.nbytes):
         self.max_bytes = int(max_bytes)
         self._sizeof = sizeof
-        self._data = {}
+        self._data = {}      # insertion/recency-ordered (dict is ordered)
+        self._sizes = {}     # key -> accounted bytes
         self._bytes = 0
         self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0   # entries dropped to make room (monotonic)
+        self.rejected = 0    # oversize entries refused outright (monotonic)
 
     def get(self, key):
         with self._lock:
-            return self._data.get(key)
+            if key in self._data:
+                # refresh recency: move to the MRU end
+                value = self._data.pop(key)
+                self._data[key] = value
+                self.hits += 1
+                return value
+            self.misses += 1
+            return None
+
+    def _evict_lru_locked(self):
+        key, _ = next(iter(self._data.items()))
+        self._data.pop(key)
+        self._bytes -= self._sizes.pop(key)
+        self.evictions += 1
 
     def put(self, key, value, nbytes=None):
-        size = self._sizeof(value) if nbytes is None else nbytes
+        size = int(self._sizeof(value) if nbytes is None else nbytes)
         with self._lock:
             if key in self._data:
                 return
-            if self._bytes + size > self.max_bytes:
-                self._data.clear()
-                self._bytes = 0
+            if size > self.max_bytes:
+                # inserting would leave the cache over budget however much
+                # is evicted: refuse (the caller recomputes, nothing breaks)
+                self.rejected += 1
+                return
+            while self._bytes + size > self.max_bytes and self._data:
+                self._evict_lru_locked()
             self._data[key] = value
+            self._sizes[key] = size
             self._bytes += size
+
+    def evict_bytes(self, target_bytes):
+        """Evict LRU entries until at least ``target_bytes`` of accounted
+        cache bytes are freed (or the cache is empty).  Returns
+        ``(bytes_freed, entries_evicted)`` — counted inside the lock so the
+        memory-pressure caller
+        (:meth:`bqueryd_tpu.ops.workingset.WorkingSet.evict_under_pressure`)
+        never misattributes a concurrent capacity eviction."""
+        freed = 0
+        count = 0
+        with self._lock:
+            while freed < target_bytes and self._data:
+                key, _ = next(iter(self._data.items()))
+                self._data.pop(key)
+                freed += self._sizes.pop(key)
+                count += 1
+                self.evictions += 1
+            self._bytes -= freed
+        return freed, count
 
     def clear(self):
         with self._lock:
             self._data.clear()
+            self._sizes.clear()
             self._bytes = 0
+
+    def stats(self):
+        """JSON-safe counters snapshot (hit rate left to the reader so the
+        snapshot stays raw-mergeable)."""
+        with self._lock:
+            return {
+                "entries": len(self._data),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "rejected": self.rejected,
+            }
 
     @property
     def nbytes(self):

@@ -35,10 +35,18 @@ class PhaseTimer:
     obs.trace.PHASE_SPAN_NAMES' ``open`` -> ``storage_decode``)."""
 
     def __init__(self, recorder=None, span_names=None):
+        import threading
+
         self.timings = {}
         self.recorder = recorder
         self.span_names = span_names or {}
         self._started = time.perf_counter()
+        # phases may now run CONCURRENTLY (the pipelined per-shard engine
+        # path times every shard's phases into one timer); the lock keeps
+        # the read-modify-write sum from losing updates.  Busy sums of
+        # overlapped phases legitimately exceed the wall — that overlap is
+        # exactly what bench.py's pipeline section measures.
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name):
@@ -48,7 +56,8 @@ class PhaseTimer:
             yield
         finally:
             duration = time.perf_counter() - t0
-            self.timings[name] = self.timings.get(name, 0.0) + duration
+            with self._lock:
+                self.timings[name] = self.timings.get(name, 0.0) + duration
             if self.recorder is not None:
                 self.recorder.record(
                     self.span_names.get(name, name), start_ts, duration

@@ -796,11 +796,89 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu.obs import profile as obs_profile
 
         obs_profile.profiler().bind(self.metrics)
+        self._bind_pipeline_metrics()
         # join a multi-host JAX job if configured (pod slice = one logical
         # calc worker; must happen before any JAX backend touch)
         from bqueryd_tpu import ops
 
         ops.maybe_init_distributed(self.logger)
+
+    def _bind_pipeline_metrics(self):
+        """Pipeline + working-set telemetry on this node's registry: stage
+        busy clocks (process-global — the worker owns the process's data
+        path), working-set segment counters (per mesh executor, created
+        lazily: gauges read 0 until the first mesh query), and result-cache
+        counters.  All fn-backed so a scrape reads live state."""
+        from bqueryd_tpu.parallel import pipeline
+
+        self.metrics.gauge(
+            "bqueryd_tpu_pipeline_threads",
+            "effective shard-pipeline pool width "
+            "(BQUERYD_TPU_PIPELINE_THREADS)",
+            fn=pipeline.pipeline_threads,
+        )
+        for stage_name in pipeline.STAGES:
+            self.metrics.gauge(
+                "bqueryd_tpu_pipeline_busy_seconds",
+                "cumulative wall spent inside each pipeline stage across "
+                "all threads (sum > query wall proves stage overlap)",
+                labels={"stage": stage_name},
+                fn=(lambda s=stage_name: pipeline.clock().busy_seconds(s)),
+            )
+
+        def ws_stat(segment, field):
+            executor = self._mesh_executor
+            if executor is None:
+                return 0
+            # direct attribute reads (plain ints under the GIL): a /metrics
+            # scrape must not rebuild full stats() snapshots — 12 gauges per
+            # scrape would take every cache lock 4x each against the hot path
+            cache = executor.workingset.segment(segment)
+            return cache.nbytes if field == "bytes" else getattr(cache, field)
+
+        for segment in ("align", "codes", "blocks"):
+            for field, help_text in (
+                ("bytes", "bytes held per working-set cache segment"),
+                ("hits", "working-set cache hits per segment (monotonic)"),
+                ("misses",
+                 "working-set cache misses per segment (monotonic)"),
+                ("evictions",
+                 "working-set LRU evictions per segment (monotonic)"),
+            ):
+                self.metrics.gauge(
+                    f"bqueryd_tpu_workingset_{field}",
+                    help_text,
+                    labels={"segment": segment},
+                    fn=(
+                        lambda s=segment, f=field: ws_stat(s, f)
+                    ),
+                )
+        self.metrics.gauge(
+            "bqueryd_tpu_workingset_pressure_evictions",
+            "device cache entries shed by the HBM watermark policy "
+            "(monotonic)",
+            fn=lambda: (
+                0 if self._mesh_executor is None
+                else self._mesh_executor.workingset.pressure_evictions
+            ),
+        )
+
+        def result_stat(field):
+            cache = self._result_cache
+            if cache is None or cache is False:  # unbuilt or disabled
+                return 0
+            return getattr(cache, field)
+
+        for field, help_text in (
+            ("hits", "worker result-cache hits (monotonic)"),
+            ("misses", "worker result-cache misses (monotonic)"),
+            ("evictions", "worker result-cache LRU evictions (monotonic)"),
+        ):
+            self.metrics.gauge(
+                f"bqueryd_tpu_result_cache_{field}",
+                help_text,
+                fn=(lambda f=field: result_stat(f)),
+            )
 
     def go(self):
         if os.environ.get("BQUERYD_TPU_WARMUP", "1") == "1":
@@ -988,10 +1066,18 @@ class WorkerNode(WorkerBase):
                 tables[0], query, strategy=strategy
             )
         self.engine.timer = timer
-        payloads = [
-            self.engine.execute_local(t, query, strategy=strategy)
-            for t in tables
-        ]
+        # pipelined per-shard fallback: shards run on the bounded pipeline
+        # pool (BQUERYD_TPU_PIPELINE_THREADS; 1 restores the serial loop),
+        # so shard i+1's decode+factorize overlaps shard i's kernel — the
+        # engine's caches are lock-protected and map_ordered returns
+        # payloads in input order, keeping hostmerge.merge_payloads
+        # deterministic (bit-identical to the serial path)
+        from bqueryd_tpu.parallel import pipeline
+
+        payloads = pipeline.map_ordered(
+            lambda t: self.engine.execute_local(t, query, strategy=strategy),
+            tables,
+        )
         with timer.phase("hostmerge"):
             merged = hostmerge.merge_payloads(payloads)
         from bqueryd_tpu.models.query import ResultPayload

@@ -1,0 +1,422 @@
+"""Pipelined shard execution + the device-resident working-set cache.
+
+Covers ISSUE 4: LRU byte-capped cache semantics (oversize reject, LRU
+segment eviction, eviction counters), the bounded pipeline pool (ordered
+results, serial degradation, stage busy clocks), bit-identical int64
+aggregates between the pipelined and serial per-shard engine paths on the
+differential-fuzz corpus, and the working-set layer's invalidation rules —
+meta.json mtime bump, column-set change, and eviction-under-HBM-pressure
+must all miss; a repeat query with a different measure column or aggregate
+op must hit the codes/alignment segments with ZERO factorize calls.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.models.query import GroupByQuery, QueryEngine
+from bqueryd_tpu.parallel import hostmerge, pipeline
+from bqueryd_tpu.parallel.executor import MeshQueryExecutor, make_mesh
+from bqueryd_tpu.storage.ctable import ctable
+from bqueryd_tpu.utils.cache import BytesCappedCache
+
+
+class _Blob:
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+# -- LRU cache semantics (satellite: utils/cache.py fix) ---------------------
+
+def test_cache_rejects_oversize_entry():
+    cache = BytesCappedCache(100)
+    cache.put("big", _Blob(101))
+    assert "big" not in cache
+    assert cache.nbytes == 0 and len(cache) == 0
+    assert cache.rejected == 1
+    # the budget-sized entry still fits exactly
+    cache.put("fits", _Blob(100))
+    assert "fits" in cache and cache.nbytes == 100
+
+
+def test_cache_evicts_lru_not_wholesale():
+    cache = BytesCappedCache(30)
+    for key in ("a", "b", "c"):
+        cache.put(key, _Blob(10))
+    assert cache.get("a") is not None  # refresh recency: b is now LRU
+    cache.put("d", _Blob(10))
+    assert "b" not in cache, "LRU entry must go first"
+    assert all(k in cache for k in ("a", "c", "d")), (
+        "eviction must be segmented, not a wholesale clear"
+    )
+    assert cache.evictions == 1
+    assert cache.nbytes == 30
+
+
+def test_cache_never_ends_over_budget():
+    cache = BytesCappedCache(25)
+    for i in range(10):
+        cache.put(i, _Blob(10))
+        assert cache.nbytes <= 25
+    assert len(cache) == 2  # two 10-byte entries fit a 25-byte budget
+    assert cache.evictions == 8
+
+
+def test_cache_stats_and_evict_bytes():
+    cache = BytesCappedCache(100)
+    for key in ("a", "b", "c"):
+        cache.put(key, _Blob(20))
+    cache.get("a")
+    cache.get("zzz")
+    stats = cache.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    assert stats["entries"] == 3 and stats["bytes"] == 60
+    freed, count = cache.evict_bytes(30)  # b then c are LRU after a's refresh
+    assert freed == 40 and count == 2 and cache.evictions == 2
+    assert "a" in cache and "b" not in cache
+
+
+# -- pipeline pool -----------------------------------------------------------
+
+def test_map_ordered_preserves_input_order(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "4")
+    rng = np.random.RandomState(3)
+    delays = rng.random(12) * 0.02
+
+    def job(i):
+        time.sleep(delays[i])
+        return i * 10
+
+    assert pipeline.map_ordered(job, range(12)) == [i * 10 for i in range(12)]
+
+
+def test_map_ordered_serial_at_one_thread(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "1")
+    seen = []
+
+    def job(i):
+        seen.append(threading.current_thread())
+        return i
+
+    assert pipeline.map_ordered(job, range(4)) == list(range(4))
+    assert all(t is threading.current_thread() for t in seen), (
+        "one-thread pipelines must run stages on the calling thread"
+    )
+
+
+def test_map_ordered_propagates_exceptions(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "3")
+
+    def job(i):
+        if i == 2:
+            raise ValueError("boom")
+        return i
+
+    with pytest.raises(ValueError, match="boom"):
+        pipeline.map_ordered(job, range(5))
+
+
+def test_pipeline_threads_env_parsing(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "3")
+    assert pipeline.pipeline_threads() == 3
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "garbage")
+    assert pipeline.pipeline_threads() == pipeline._DEFAULT_THREADS
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "0")
+    assert pipeline.pipeline_threads() == pipeline._DEFAULT_THREADS
+    monkeypatch.delenv("BQUERYD_TPU_PIPELINE_THREADS")
+    assert pipeline.pipeline_threads() == pipeline._DEFAULT_THREADS
+
+
+def test_stage_clock_accumulates_busy_time():
+    clock = pipeline.clock()
+    before = clock.snapshot()["busy_seconds"].get("decode", 0.0)
+    with pipeline.stage("decode"):
+        time.sleep(0.01)
+    snap = clock.snapshot()
+    assert snap["busy_seconds"]["decode"] >= before + 0.009
+    assert snap["calls"]["decode"] >= 1
+
+
+# -- pipelined engine path == serial path, bit for bit -----------------------
+
+N_SHARDS = 3
+
+
+def _fuzz_shards(tmp_path, seed=5):
+    """A slice of the differential-fuzz corpus: int64 at limb-straddling
+    magnitudes, float32 NaNs, dict keys with nulls."""
+    rng = np.random.default_rng(seed)
+    frames, tables = [], []
+    for i in range(N_SHARDS):
+        n = 2_000
+        frames.append(
+            pd.DataFrame(
+                {
+                    "k_int": rng.integers(0, 7, n).astype(np.int64),
+                    "k_str": rng.choice(
+                        ["a", "b", "c", None], n, p=[0.4, 0.3, 0.2, 0.1]
+                    ),
+                    "v_small": rng.integers(-1000, 1000, n).astype(np.int64),
+                    "v_big": rng.integers(
+                        -(2**60), 2**60, n
+                    ).astype(np.int64),
+                    "v_float": np.where(
+                        rng.random(n) < 0.05,
+                        np.nan,
+                        rng.random(n) * 100 - 50,
+                    ).astype(np.float32),
+                    "sel": rng.random(n).astype(np.float64),
+                }
+            )
+        )
+        root = str(tmp_path / f"fz{i}.bcolzs")
+        ctable.fromdataframe(frames[-1], root)
+        tables.append(ctable(root))
+    return frames, tables
+
+
+PIPELINE_CASES = [
+    (["k_int"], [["v_big", "sum", "s"]], []),
+    (["k_str"], [["v_small", "sum", "s"], ["v_float", "mean", "m"]], []),
+    (["k_int"], [["v_small", "sum", "s"]], [["sel", ">", 0.5]]),
+    # count_distinct is engine-path-only: exactly the worker fallback the
+    # pipeline pool parallelizes
+    (["k_int"], [["v_float", "count_distinct", "nd"]], []),
+]
+
+
+@pytest.mark.parametrize("case", range(len(PIPELINE_CASES)))
+def test_pipelined_engine_path_bit_identical_to_serial(
+    tmp_path, monkeypatch, case
+):
+    """The worker's per-shard engine fallback (pipeline.map_ordered over
+    execute_local) must produce BIT-identical payload merges at any pool
+    width — int64 aggregates compared with zero tolerance."""
+    _frames, tables = _fuzz_shards(tmp_path)
+    gcols, aggs, where = PIPELINE_CASES[case]
+    query = GroupByQuery(gcols, aggs, where, aggregate=True)
+
+    def run(threads):
+        monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", str(threads))
+        engine = QueryEngine()
+        payloads = pipeline.map_ordered(
+            lambda t: engine.execute_local(t, query), tables
+        )
+        return hostmerge.finalize_table(hostmerge.merge_payloads(payloads))
+
+    order_s, cols_s = run(1)
+    order_p, cols_p = run(4)
+    assert order_s == order_p
+    for col in order_s:
+        a, b = np.asarray(cols_s[col]), np.asarray(cols_p[col])
+        assert a.dtype == b.dtype
+        # exact equality for EVERY dtype (assert_array_equal treats NaN as
+        # equal to NaN): the pipelined path must be bit-identical, not close
+        np.testing.assert_array_equal(a, b)
+
+
+# -- working-set cache: hits without factorize, invalidation -----------------
+
+@pytest.fixture
+def ws_tables(tmp_path):
+    rng = np.random.RandomState(11)
+    frames, tables = [], []
+    for i in range(3):
+        df = pd.DataFrame(
+            {
+                "g": rng.randint(0, 6, 600).astype(np.int64),
+                "h": rng.randint(0, 4, 600).astype(np.int64),
+                "v": rng.randint(-30000, 30000, 600).astype(np.int64),
+                "w": rng.randint(-500, 500, 600).astype(np.int64),
+            }
+        )
+        root = str(tmp_path / f"ws{i}.bcolzs")
+        ctable.fromdataframe(df, root)
+        frames.append(df)
+        tables.append(ctable(root))
+    return frames, tables
+
+
+def _poison_factorize(monkeypatch):
+    """Make any factorize call an assertion failure (the engine and the
+    mesh alignment both go through ``ops.factorize``)."""
+    from bqueryd_tpu import ops as ops_mod
+
+    def boom(*a, **k):
+        raise AssertionError("factorize ran on what must be a cache hit")
+
+    monkeypatch.setattr(ops_mod, "factorize", boom)
+
+
+def test_different_measure_hits_codes_cache_no_factorize(
+    ws_tables, monkeypatch
+):
+    """THE acceptance probe: a warm repeat query with a DIFFERENT measure
+    column on unchanged shards performs zero factorize calls — the codes +
+    alignment segments answer, only the new measure block is built."""
+    frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    ex.execute(tables, GroupByQuery(["g"], [["v", "sum", "s"]]))
+    stats0 = ex.workingset.stats()
+
+    _poison_factorize(monkeypatch)
+    r = ex.execute(tables, GroupByQuery(["g"], [["w", "sum", "s"]]))
+    stats1 = ex.workingset.stats()
+    assert stats1["codes"]["hits"] == stats0["codes"]["hits"] + 1
+    assert stats1["align"]["hits"] == stats0["align"]["hits"] + 1
+    # only the new measure column missed (decode+pack+H2D for `w` alone)
+    assert stats1["blocks"]["entries"] == stats0["blocks"]["entries"] + 1
+
+    full = pd.concat(frames, ignore_index=True)
+    expect = full.groupby("g")["w"].sum()
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_array_equal(
+        r["aggs"][0]["sum"][order], expect.sort_index().to_numpy()
+    )
+
+
+def test_different_agg_op_hits_codes_and_blocks(ws_tables, monkeypatch):
+    """Same measure, different aggregate op: codes AND blocks both hit —
+    the only new work is the (cached-program) kernel dispatch."""
+    frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    ex.execute(tables, GroupByQuery(["g"], [["v", "sum", "s"]]))
+    stats0 = ex.workingset.stats()
+
+    _poison_factorize(monkeypatch)
+    r = ex.execute(tables, GroupByQuery(["g"], [["v", "mean", "m"]]))
+    stats1 = ex.workingset.stats()
+    assert stats1["codes"]["hits"] == stats0["codes"]["hits"] + 1
+    assert stats1["blocks"]["hits"] == stats0["blocks"]["hits"] + 1
+    assert stats1["blocks"]["entries"] == stats0["blocks"]["entries"]
+
+    full = pd.concat(frames, ignore_index=True)
+    expect = full.groupby("g")["v"].mean().sort_index().to_numpy()
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_allclose(
+        r["aggs"][0]["sum"][order] / r["aggs"][0]["count"][order],
+        expect, rtol=1e-12,
+    )
+
+
+def test_meta_mtime_bump_invalidates_working_set(ws_tables):
+    """Shard activation (meta.json rewrite) must MISS: the content key
+    carries meta.json's inode+mtime."""
+    _frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    query = GroupByQuery(["g"], [["v", "sum", "s"]])
+    ex.execute(tables, query)
+    stats0 = ex.workingset.stats()
+
+    meta = os.path.join(tables[0].rootdir, "meta.json")
+    st = os.stat(meta)
+    os.utime(meta, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000_000))
+    ex.execute(tables, query)
+    stats1 = ex.workingset.stats()
+    assert stats1["align"]["misses"] == stats0["align"]["misses"] + 1
+    assert stats1["codes"]["misses"] == stats0["codes"]["misses"] + 1
+    assert stats1["align"]["entries"] == 2  # old + new identity
+
+
+def test_column_set_change_misses(ws_tables, monkeypatch):
+    """A different groupby column set is a different content key: align and
+    codes must miss (and factorize the new key column)."""
+    _frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    ex.execute(tables, GroupByQuery(["g"], [["v", "sum", "s"]]))
+    stats0 = ex.workingset.stats()
+    ex.execute(tables, GroupByQuery(["h"], [["v", "sum", "s"]]))
+    stats1 = ex.workingset.stats()
+    assert stats1["align"]["misses"] == stats0["align"]["misses"] + 1
+    assert stats1["codes"]["misses"] == stats0["codes"]["misses"] + 1
+    assert stats1["align"]["entries"] == 2
+
+
+def test_eviction_under_pressure_forces_miss(ws_tables):
+    """The HBM watermark policy sheds device segments (blocks before
+    codes); the next query misses and rebuilds, and the shed is counted."""
+    frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    query = GroupByQuery(["g"], [["v", "sum", "s"]])
+    ex.execute(tables, query)
+    assert len(ex._codes_cache) == 1 and len(ex._hbm_cache) == 1
+
+    # target far above the cached bytes: everything device-side must go
+    freed = ex.workingset.evict_under_pressure(
+        sample={"bytes_in_use": 2 * 10**12, "bytes_limit": 10**12},
+        watermark=0.5,
+    )
+    assert freed > 0
+    assert ex.workingset.pressure_evictions >= 2
+    assert len(ex._codes_cache) == 0 and len(ex._hbm_cache) == 0
+    assert len(ex._align_cache) == 1, "host alignment is not device memory"
+
+    stats0 = ex.workingset.stats()
+    r = ex.execute(tables, query)  # rebuilds from the warm alignment
+    stats1 = ex.workingset.stats()
+    assert stats1["codes"]["misses"] == stats0["codes"]["misses"] + 1
+    full = pd.concat(frames, ignore_index=True)
+    expect = full.groupby("g")["v"].sum().sort_index().to_numpy()
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_array_equal(r["aggs"][0]["sum"][order], expect)
+
+
+def test_pressure_eviction_noops_without_sample():
+    from bqueryd_tpu.ops.workingset import WorkingSet
+
+    ws = WorkingSet()
+    assert ws.evict_under_pressure(sample=None, watermark=0.9) == 0
+    assert ws.evict_under_pressure(
+        sample={"bytes_in_use": 10, "bytes_limit": 100}, watermark=0.9
+    ) == 0  # under the watermark
+    assert ws.evict_under_pressure(
+        sample={"bytes_in_use": 99, "bytes_limit": 100}, watermark=0
+    ) == 0  # disabled
+
+
+def test_fused_multiagg_uploads_one_block(ws_tables):
+    """sum+count+mean over ONE column must upload ONE measure block (the
+    fused gather: measure_index maps all three aggs to the same slot) and
+    still match pandas."""
+    frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    r = ex.execute(
+        tables,
+        GroupByQuery(
+            ["g"],
+            [["v", "sum", "s"], ["v", "count", "n"], ["v", "mean", "m"]],
+        ),
+    )
+    assert ex.workingset.stats()["blocks"]["entries"] == 1
+    full = pd.concat(frames, ignore_index=True)
+    g = full.groupby("g")["v"]
+    order = np.argsort(r["keys"]["g"])
+    np.testing.assert_array_equal(
+        r["aggs"][0]["sum"][order], g.sum().sort_index().to_numpy()
+    )
+    np.testing.assert_array_equal(
+        r["aggs"][1]["count"][order], g.count().sort_index().to_numpy()
+    )
+    np.testing.assert_allclose(
+        r["aggs"][2]["sum"][order] / r["aggs"][2]["count"][order],
+        g.mean().sort_index().to_numpy(),
+        rtol=1e-12,
+    )
+
+
+def test_storage_prefetch_warms_decode_cache(ws_tables, monkeypatch):
+    """ctable.prefetch decodes on the pipeline pool into the process cache;
+    the subsequent column_raw is a cache hit (same array object)."""
+    from bqueryd_tpu.storage.ctable import free_cachemem
+
+    _frames, tables = ws_tables
+    monkeypatch.setenv("BQUERYD_TPU_PIPELINE_THREADS", "2")
+    free_cachemem()
+    futs = tables[0].prefetch(["v", "missing_column"])
+    assert len(futs) == 1, "unknown columns are skipped, not errors"
+    decoded = futs[0].result()
+    assert tables[0].column_raw("v") is decoded, "prefetch must warm the cache"

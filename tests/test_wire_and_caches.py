@@ -352,13 +352,16 @@ def test_repeat_query_hits_caches(shard_tables):
     ex = MeshQueryExecutor(mesh=make_mesh())
     q = GroupByQuery(["g"], [["v", "sum", "vs"]])
     ex.execute(tables, q)
-    assert len(ex._hbm_cache) == 2  # codes + one measure block
+    assert len(ex._codes_cache) == 1  # folded group codes
+    assert len(ex._hbm_cache) == 1    # one measure block
     assert len(ex._align_cache) == 1
-    before = len(ex._hbm_cache)
+    before = (len(ex._codes_cache), len(ex._hbm_cache))
     ex.execute(tables, q)
-    assert len(ex._hbm_cache) == before  # no new blocks on repeat
+    # no new blocks on repeat
+    assert (len(ex._codes_cache), len(ex._hbm_cache)) == before
     ex.clear_caches()
     assert len(ex._hbm_cache) == 0 and ex._hbm_cache.nbytes == 0
+    assert len(ex._codes_cache) == 0 and len(ex._align_cache) == 0
 
 
 def test_where_signature_distinguishes_filters():
