@@ -1245,6 +1245,37 @@ def main():
                     flush=True,
                 )
 
+        # -- static-analysis guard: suite runtime + per-family finding
+        # counts (proves the full pass stays interactive — a few seconds —
+        # and that the tree the bench measured was lint-clean)
+        static_analysis_detail = {}
+        try:
+            from bqueryd_tpu.analysis import run_suite as _analysis_suite
+
+            _ar = _analysis_suite(
+                root=os.path.dirname(os.path.abspath(__file__))
+            )
+            static_analysis_detail = {
+                "duration_s": round(_ar.duration_s, 4),
+                "files_scanned": _ar.files_scanned,
+                "findings_new": len(_ar.new),
+                "findings_suppressed": len(_ar.suppressed),
+                "findings_baselined": len(_ar.baselined),
+                "counts_by_analyzer": dict(_ar.per_analyzer),
+                "under_5s": _ar.duration_s < 5.0,
+            }
+            print(
+                f"[bench] static_analysis: {len(_ar.new)} new findings, "
+                f"{_ar.files_scanned} files in {_ar.duration_s:.2f}s",
+                flush=True,
+            )
+        except Exception as exc:
+            print(
+                f"[bench] static_analysis section failed: {exc!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+
         if HEADLINE in completed:
             head_name = HEADLINE
         elif completed:
@@ -1302,6 +1333,9 @@ def main():
             # ratio, working-set / storage / result cache hit rates, and
             # the zero-factorize codes-cache probe
             "pipeline": pipeline_detail,
+            # suite runtime + per-family finding counts (the bench guard
+            # proving the full static pass stays under a few seconds)
+            "static_analysis": static_analysis_detail,
             "total_s": round(time.time() - t_start, 1),
         }
         with open(detail_path, "w") as f:

@@ -1,0 +1,69 @@
+"""Project-wide static analysis: the correctness tooling tier-1 gates on.
+
+Once the query path went multi-threaded with device-resident caches (PR 4),
+the dominant failure classes stopped being kernel math and became lock
+discipline, silent recompiles at the jit boundary, and config/wire drift —
+classes a static pass catches before they are flaky-test archaeology.  This
+package is that pass, runnable three ways:
+
+* ``python -m bqueryd_tpu.analysis`` — text report, non-zero exit on new
+  findings (``--format json`` for the machine-readable artifact CI stores);
+* from tests — ``tests/test_analysis.py`` asserts the shipped tree is
+  clean, so drift fails tier-1;
+* as a library — ``run_suite()`` returns the structured result bench.py
+  records in BENCH_DETAIL.json.
+
+Analyzer families (rule ids in each module's ``RULES``):
+
+====================  =====================================================
+config-registry       every ``BQUERYD_TPU_*`` env var in one typed table
+                      (:mod:`.configreg`), README-synced, no unregistered /
+                      dead / import-latched reads
+lock-discipline       declared guarded attributes touched only under their
+                      lock (:mod:`.concurrency`); runtime lock-ORDER
+                      recording with cycle detection lives in
+                      :mod:`.lockorder` and is driven from tests
+jit-purity            host impurity and cache-key hazards inside jitted
+                      bodies in ``ops/`` + ``parallel/executor.py``
+                      (:mod:`.purity`), cross-checked against the PR 3
+                      compile-profile counters via ``jit-uninstrumented``
+wire-schema           envelope key literals in controller/worker/rpc vs the
+                      schemas declared in ``messages.py`` (:mod:`.wire`)
+metric-lint /         static twins of the PR 2/3 runtime metric lints
+metric-readme         (:mod:`.metricslint`); the runtime entry points in
+                      ``obs.metrics`` keep working unchanged
+====================  =====================================================
+
+Suppression model (framework-owned, :mod:`.core`): inline
+``# bqtpu: allow[rule-id] <reason>`` pragmas with mandatory reasons, plus
+the ``ANALYSIS_BASELINE.json`` fingerprint baseline for grandfathered
+findings — shipped near-empty, and stale entries are themselves findings.
+"""
+
+from bqueryd_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    SuiteResult,
+    run_suite,
+)
+
+
+def default_analyzers():
+    """The full suite, in report order."""
+    from bqueryd_tpu.analysis.concurrency import LockDisciplineAnalyzer
+    from bqueryd_tpu.analysis.configreg import ConfigRegistryAnalyzer
+    from bqueryd_tpu.analysis.metricslint import (
+        MetricNameAnalyzer,
+        MetricReadmeAnalyzer,
+    )
+    from bqueryd_tpu.analysis.purity import JitPurityAnalyzer
+    from bqueryd_tpu.analysis.wire import WireSchemaAnalyzer
+
+    return [
+        ConfigRegistryAnalyzer(),
+        LockDisciplineAnalyzer(),
+        JitPurityAnalyzer(),
+        WireSchemaAnalyzer(),
+        MetricNameAnalyzer(),
+        MetricReadmeAnalyzer(),
+    ]

@@ -1,0 +1,389 @@
+"""jit-boundary purity lint over ``ops/`` and ``parallel/executor.py``.
+
+A jitted function body runs at TRACE time: host-side effects inside it
+either burn at every retrace (``time.*``, ``os.environ``), silently bake a
+stale value into the compiled program, or — the expensive class — force a
+retrace/recompile per call (Python branching on tracer values, host
+coercion of tracers, unhashable static arguments).  PR 3's compile-profile
+counters measure these as jit-cache misses after the fact; this lint
+catches the patterns before they ship.
+
+Detection is lexical over the jit boundary the code actually declares:
+
+* functions decorated ``@jax.jit`` / ``@functools.partial(jax.jit,
+  static_argnames=(...))`` (the package's idiom), and module-level
+  ``name = jax.jit(fn)`` bindings;
+* a parameter named in ``static_argnames`` is compile-time constant —
+  branching on it, coercing it, and numpy over it are all fine; everything
+  else is treated as traced.
+
+Rules (scoped, so the shipped tree is clean without blanket suppressions):
+
+* ``jit-impure-time`` / ``jit-impure-env`` — ``time.*`` call or environment
+  access inside a jit body;
+* ``jit-host-numpy`` — a ``np.*`` call applied directly to a traced
+  parameter (forces device->host sync, breaks under tracing);
+* ``jit-traced-coerce`` — ``float()/int()/bool()`` or ``.item()`` on a
+  traced parameter (ConcretizationTypeError under jit, silent sync via
+  ``__jax_array__`` otherwise);
+* ``jit-traced-branch`` — ``if``/``while`` whose test references a traced
+  parameter (``is None``/``is not None`` structure checks excluded: those
+  are static pytree structure, the package's ``mask=None`` idiom);
+* ``jit-nonhashable-static`` — a call site passing a list/dict/set literal
+  for a ``static_argnames`` parameter of a jitted function defined in the
+  same module (unhashable static arg: TypeError at best, per-call recompile
+  via a hashable-but-fresh wrapper at worst);
+* ``jit-lru-closure`` — ``functools.lru_cache`` on a function nested inside
+  another function: the cache keys on the closure's captured objects'
+  identity, pinning arrays alive and missing on every fresh closure;
+* ``jit-uninstrumented`` — a module-level jitted entry point never wrapped
+  with the compile profiler's ``instrument()`` in its module: its compiles
+  and cache misses would be invisible to the PR 3 counters this lint is
+  cross-checked against.
+"""
+
+import ast
+import os
+
+from bqueryd_tpu.analysis.core import Finding
+
+#: the jit boundary lives in the kernel layer; control-plane modules don't
+#: jit and would only add noise
+SCOPE_DIRS = ("ops",)
+SCOPE_FILES = ("parallel/executor.py",)
+
+
+def in_scope(relpath, package):
+    rel = relpath.split("/", 1)[1] if "/" in relpath else relpath
+    head = rel.split("/", 1)[0]
+    return head in SCOPE_DIRS or rel in SCOPE_FILES
+
+
+def _is_jax_jit(node):
+    """True for ``jax.jit`` / bare ``jit`` attribute or name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _static_spec_from_keywords(keywords):
+    """Raw static spec from jit keywords: strings from ``static_argnames``,
+    ints from ``static_argnums`` (resolved to names by the caller, which
+    holds the FunctionDef)."""
+    spec = []
+    for kw in keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            try:
+                value = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(value, (str, int)):
+                value = (value,)
+            spec.extend(v for v in value if isinstance(v, (str, int)))
+    return tuple(spec)
+
+
+def _partial_jit_static_spec(call):
+    """For ``functools.partial(jax.jit, static_arg*=...)`` return the raw
+    static spec tuple (possibly empty); None if not a jit partial."""
+    func = call.func
+    is_partial = (
+        isinstance(func, ast.Attribute) and func.attr == "partial"
+    ) or (isinstance(func, ast.Name) and func.id == "partial")
+    if not (is_partial and call.args and _is_jax_jit(call.args[0])):
+        return None
+    return _static_spec_from_keywords(call.keywords)
+
+
+def _jit_decoration(func_def):
+    """``(is_jitted, static_names)`` from a FunctionDef's decorators —
+    ``static_argnums`` indices are resolved against the positional
+    parameter list so positionally-static params are never misread as
+    traced."""
+    arg_names = [a.arg for a in func_def.args.args]
+
+    def resolve(spec):
+        names = []
+        for entry in spec:
+            if isinstance(entry, int):
+                if 0 <= entry < len(arg_names):
+                    names.append(arg_names[entry])
+            else:
+                names.append(entry)
+        return tuple(names)
+
+    for dec in func_def.decorator_list:
+        if _is_jax_jit(dec):
+            return True, ()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                return True, resolve(
+                    _static_spec_from_keywords(dec.keywords)
+                )
+            spec = _partial_jit_static_spec(dec)
+            if spec is not None:
+                return True, resolve(spec)
+    return False, ()
+
+
+class _JitBodyChecker(ast.NodeVisitor):
+    def __init__(self, relpath, func_name, traced_params):
+        self.relpath = relpath
+        self.func_name = func_name
+        self.traced = traced_params
+        self.findings = []
+
+    def _finding(self, rule, node, message, anchor):
+        self.findings.append(Finding(
+            rule, self.relpath, node.lineno,
+            f"in jitted {self.func_name}: {message}",
+            symbol=f"{self.func_name}.{anchor}",
+        ))
+
+    def _is_traced_name(self, node):
+        return isinstance(node, ast.Name) and node.id in self.traced
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            if isinstance(root, ast.Name):
+                if root.id == "time":
+                    self._finding(
+                        "jit-impure-time", node,
+                        f"time.{func.attr}() runs at trace time and bakes "
+                        "a constant into the compiled program",
+                        f"time.{func.attr}",
+                    )
+                elif root.id == "np" and any(
+                    self._is_traced_name(a) for a in node.args
+                ):
+                    self._finding(
+                        "jit-host-numpy", node,
+                        f"np.{func.attr}() applied to a traced argument "
+                        "forces host transfer / fails under trace",
+                        f"np.{func.attr}",
+                    )
+                elif root.id == "os" and func.attr == "getenv":
+                    self._finding(
+                        "jit-impure-env", node,
+                        "os.getenv() read at trace time: recompiles won't "
+                        "see changed values, calls won't re-read it",
+                        "os.getenv",
+                    )
+            if func.attr == "item" and (
+                self._is_traced_name(func.value)
+            ):
+                self._finding(
+                    "jit-traced-coerce", node,
+                    f"{func.value.id}.item() concretizes a tracer "
+                    "(device sync / ConcretizationTypeError)",
+                    f"{func.value.id}.item",
+                )
+        elif isinstance(func, ast.Name):
+            if func.id in ("float", "int", "bool") and node.args and (
+                self._is_traced_name(node.args[0])
+            ):
+                self._finding(
+                    "jit-traced-coerce", node,
+                    f"{func.id}({node.args[0].id}) coerces a traced "
+                    "argument to a host scalar",
+                    f"{func.id}.{node.args[0].id}",
+                )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if (
+            node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ):
+            self._finding(
+                "jit-impure-env", node,
+                "os.environ read at trace time: the compiled program "
+                "latches whatever the value was at first trace",
+                "os.environ",
+            )
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind):
+        test = node.test
+        # `x is None` / `x is not None` on a traced arg is STATIC pytree
+        # structure (the mask=None idiom), not a tracer branch
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return
+        for sub in ast.walk(test):
+            if self._is_traced_name(sub):
+                self._finding(
+                    "jit-traced-branch", node,
+                    f"{kind} branches on traced argument "
+                    f"{sub.id!r}: concretization error under jit, or a "
+                    "silent retrace per distinct value",
+                    f"{kind}.{sub.id}",
+                )
+                return
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs: params shadow the outer traced names
+        inner_params = {a.arg for a in node.args.args}
+        outer = self.traced
+        self.traced = self.traced - inner_params
+        self.generic_visit(node)
+        self.traced = outer
+
+
+def _is_lru_cache_decorator(dec):
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Attribute):
+        return target.attr == "lru_cache"
+    return isinstance(target, ast.Name) and target.id == "lru_cache"
+
+
+class JitPurityAnalyzer:
+    name = "jit-purity"
+
+    RULES = {
+        "jit-impure-time": "time.* call inside a jitted body",
+        "jit-impure-env": "environment access inside a jitted body",
+        "jit-host-numpy": "host numpy applied to a traced argument",
+        "jit-traced-coerce": "host scalar coercion of a traced argument",
+        "jit-traced-branch": "Python branch on a traced argument",
+        "jit-nonhashable-static":
+            "list/dict/set literal passed for a static_argnames parameter",
+        "jit-lru-closure":
+            "functools.lru_cache on a closure (cache keyed on captured "
+            "object identity; pins arrays)",
+        "jit-uninstrumented":
+            "module-level jitted entry point not wrapped with the compile "
+            "profiler's instrument()",
+    }
+
+    def run(self, project):
+        findings = []
+        for sf in project.files:
+            if sf.tree is None or not in_scope(sf.relpath, project.package):
+                continue
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf):
+        findings = []
+        jitted = {}      # name -> static names (module-level jit defs)
+        instrumented = set()
+        module_name = os.path.basename(sf.relpath)
+
+        for node in ast.walk(sf.tree):
+            # name = <...>.instrument("label", fn) marks fn (and the bound
+            # name) as visible to the compile-profile counters
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "instrument":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        instrumented.add(arg.id)
+                    elif isinstance(arg, ast.Call):
+                        # instrument("label", jax.jit(fn))
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name):
+                                instrumented.add(sub.id)
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                is_jitted, static = _jit_decoration(node)
+                if is_jitted:
+                    jitted[node.name] = set(static)
+                    params = {a.arg for a in node.args.args}
+                    checker = _JitBodyChecker(
+                        sf.relpath, node.name, params - set(static)
+                    )
+                    for stmt in node.body:
+                        checker.visit(stmt)
+                    findings.extend(checker.findings)
+                for dec in node.decorator_list:
+                    if _is_lru_cache_decorator(dec) and self._is_nested(
+                        sf.tree, node
+                    ):
+                        findings.append(Finding(
+                            "jit-lru-closure", sf.relpath, node.lineno,
+                            f"lru_cache on nested function {node.name!r}: "
+                            "the cache outlives the closure and keys on "
+                            "captured identity",
+                            symbol=node.name,
+                        ))
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                # name = jax.jit(fn) at module level
+                call = node.value
+                if _is_jax_jit(call.func) and len(node.targets) == 1 and (
+                    isinstance(node.targets[0], ast.Name)
+                ):
+                    jitted.setdefault(node.targets[0].id, set())
+
+        # call-site check: literal unhashables into static args
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted
+            ):
+                continue
+            static = jitted[node.func.id]
+            for kw in node.keywords:
+                if kw.arg in static and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)
+                ):
+                    findings.append(Finding(
+                        "jit-nonhashable-static", sf.relpath, kw.value.lineno,
+                        f"call to {node.func.id} passes a "
+                        f"{type(kw.value).__name__.lower()} literal for "
+                        f"static arg {kw.arg!r} — unhashable static args "
+                        "break the jit cache key",
+                        symbol=f"{node.func.id}.{kw.arg}",
+                    ))
+
+        # compile-profile coverage: every module-level jitted entry point
+        # must be instrumented somewhere in its module
+        for name in sorted(jitted):
+            if name not in instrumented:
+                findings.append(Finding(
+                    "jit-uninstrumented", sf.relpath, 0,
+                    f"jitted entry point {name!r} in {module_name} is never "
+                    "wrapped with profile.instrument(): its compiles are "
+                    "invisible to the compile-profile counters",
+                    symbol=name,
+                ))
+        return findings
+
+    @staticmethod
+    def _is_nested(tree, func_def):
+        """True when ``func_def`` is defined inside another function."""
+        class Finder(ast.NodeVisitor):
+            def __init__(self):
+                self.nested = False
+                self._stack = 0
+
+            def visit_FunctionDef(self, node):
+                if node is func_def:
+                    if self._stack > 0:
+                        self.nested = True
+                    return
+                self._stack += 1
+                self.generic_visit(node)
+                self._stack -= 1
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+        finder = Finder()
+        finder.visit(tree)
+        return finder.nested

@@ -1503,7 +1503,6 @@ class ControllerNode:
             fan = msg.copy()
             fan.pop("token", None)
             fan["_relayed"] = True
-            fan["payload_fan"] = True
             try:
                 self.socket.send_multipart([addr.encode(), fan.to_json().encode()])
             except zmq.ZMQError:

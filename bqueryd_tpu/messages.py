@@ -54,6 +54,118 @@ import time
 
 PICKLE_PROTOCOL = 4
 
+# -- wire schema --------------------------------------------------------------
+# The single declared truth of every envelope key that crosses (or rides) the
+# wire, diffed by ``bqueryd_tpu.analysis.wire`` against the key literals the
+# wire modules (controller.py / worker.py / rpc.py) actually read and write:
+# a key added on one side without the other is a LINT failure, not a silent
+# ``None`` three hops later.  Adding a key to the protocol means adding it
+# here, with help text, in the same commit.
+
+#: JSON envelope keys (Message dicts).  Keys prefixed ``_`` are controller-
+#: internal riders: they travel inside the process (and harmlessly on the
+#: wire) but no peer may ever rely on them.
+ENVELOPE_SCHEMA = {
+    # base Message fields (set by the constructor / accessors below)
+    "msg_type": "message class discriminator (msg_factory dispatch)",
+    "payload": "verb name on requests; result/error text on replies",
+    "version": "protocol version, currently 1",
+    "created": "sender timestamp (preserved across parse/copy)",
+    "params": "base64-pickled {'args', 'kwargs'} call parameters",
+    "deadline": "absolute unix deadline, propagated client->worker",
+    "trace": "distributed-tracing context {trace_id, span_id, ...}",
+    # client -> controller
+    "token": "request identity: client socket token / shard work token",
+    "priority": "admission queue priority (ascending)",
+    "client_id": "admission quota bucket for RPC(client_id=...)",
+    "function": "remote-execution verb: pickled callable name",
+    "needs_local": "route only to workers holding the file locally",
+    # controller -> worker shard dispatch
+    "parent_token": "client query a shard CalcMessage belongs to",
+    "filename": "shard rootdir(s) this work unit covers",
+    "affinity": "pin dispatch to one worker id",
+    "sole_shard": "single-shard query: worker may finalize on device",
+    "plan": "base64-pickled plan fragment (query + predicates + strategy)",
+    "worker_id": "explicit dispatch target / WRM sender identity",
+    "ticket": "download/movebcolz ticket id",
+    # worker -> controller replies
+    "data": "raw result payload bytes",
+    "phase_timings": "per-phase seconds dict; whole-call wall under _total",
+    "spans": "worker span list folded into the query trace timeline",
+    "deadline_remaining": "seconds left at reply serialization",
+    "strategy": "kernel strategy the worker actually executed",
+    "error": "failure detail on error/ticketdone paths",
+    "result": "base64-pickled rpc verb return value",
+    # worker register messages (WRM heartbeats)
+    "node": "worker host name",
+    "ip": "worker advertised IP",
+    "data_dir": "worker shard directory",
+    "data_files": "shard files the worker serves",
+    "workertype": "calc | download",
+    "pid": "worker process id",
+    "uptime": "seconds since worker start",
+    "msg_count": "messages handled by the worker",
+    "backend_wedged": "device-health latch (health scoring + routing)",
+    "work_errors": "cumulative error-counter total (health windows)",
+    "debug": "node debug-bundle slice (flight tail, compile registry, ...)",
+    "shard_stats": "per-shard planning stats (rows, min/max, cardinality)",
+    "metrics": "histogram snapshot (bucket-vector mergeable)",
+    "liveness_only": "heartbeat-thread WRM: skip data_files rescan",
+    # controller gossip + bookkeeping riders
+    "from": "gossiping controller address",
+    "info": "base64-pickled controller info snapshot (peer gossip)",
+    "others": "peer-controller snapshots inside rpc.info(include_peers)",
+    "last_seen": "controller-local: last WRM/gossip arrival time",
+    "busy": "controller-local: worker has work in flight",
+    "hb_only": "controller-local: worker seen only via heartbeats so far",
+    "_retries": "controller-internal: dispatch retry count rider",
+    "_dispatch_queued_ts": "controller-internal: dispatch queue-entry time",
+    "_relayed": "controller-internal: fan-out marker on relayed verbs",
+    "_obs": "controller-internal: per-query observability state rider",
+}
+
+#: the pickled groupby RESULT envelope (not a Message): what rpc.py unpickles
+#: from a calc reply
+RESULT_ENVELOPE_SCHEMA = {
+    "ok": "False when the query failed (error carries the reason)",
+    "busy": "admission BUSY backpressure marker (RPCBusyError client-side)",
+    "payloads": "per-shard-group ResultPayload byte strings",
+    "timings": "compacted per-phase timing summary",
+    "error": "failure reason when ok is False",
+}
+
+#: keys legitimately touched on only one side of the wire MODULES — the peer
+#: lives elsewhere (the Message base class in this module, plan/admission,
+#: client tooling).  Every waiver states where the other side is.
+WIRE_ONE_SIDED_OK = {
+    "msg_type": "written/read by Message.__init__ and msg_factory here",
+    "version": "written by Message.__init__ here; never read yet (v1)",
+    "created": "written by Message.__init__ here; age derived by readers",
+    "params": "set_args_kwargs/get_args_kwargs accessors in this module",
+    "deadline": "written via Message.set_deadline; read via the deadline "
+                "helpers in this module",
+    "trace": "set_trace/get_trace accessors in this module",
+    "priority": "written by rpc.py; read by plan/admission.py (not a wire "
+                "module)",
+    "function": "read by worker.py execute_code; set by client tooling",
+    "needs_local": "read by controller dispatch; set by download tooling",
+    "ticket": "written by controller ticketdone replies; read by download "
+              "tooling and coordination paths",
+    "last_seen": "controller-local worker_map/gossip bookkeeping",
+    "hb_only": "controller-local worker_map bookkeeping",
+    "_obs": "controller-internal rider, intentionally unread elsewhere",
+    "deadline_remaining": "informational reply field for clients/tests; "
+                          "the controller deliberately ignores it",
+    "strategy": "informational reply field (executed kernel strategy) for "
+                "clients/tests; dispatch accounting happens at send time",
+    "others": "written into get_info(); read by rpc.info() clients/tests",
+    "ip": "operator-facing WRM field surfaced via rpc.info(); the "
+          "controller routes by socket identity, not this",
+    "pid": "operator-facing WRM field surfaced via rpc.info()",
+    "uptime": "operator-facing WRM field surfaced via rpc.info()",
+    "msg_count": "operator-facing WRM field surfaced via rpc.info()",
+}
+
 
 class MalformedMessage(Exception):
     pass

@@ -70,6 +70,12 @@ class FlightRecorder:
     events (wedge latches, timeouts, worker removals, errors) are recorded
     unconditionally — they are the reason this exists."""
 
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    #: (lock-unguarded-attr)
+    _bqtpu_guarded_ = {
+        "_lock": ("_events", "_sizes", "_nbytes", "_evictions", "_seq"),
+    }
+
     def __init__(self, node_id=None, capacity=None, max_bytes=None):
         if capacity is None:
             try:
@@ -128,14 +134,17 @@ class FlightRecorder:
 
     @property
     def evictions(self):
-        return self._evictions
+        with self._lock:
+            return self._evictions
 
     @property
     def nbytes(self):
-        return self._nbytes
+        with self._lock:
+            return self._nbytes
 
     def __len__(self):
-        return len(self._events)
+        with self._lock:
+            return len(self._events)
 
 
 # -- redaction ----------------------------------------------------------------

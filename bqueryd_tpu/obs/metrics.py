@@ -68,6 +68,9 @@ class Counter:
 
     kind = "counter"
 
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    _bqtpu_guarded_ = {"_lock": ("_value",)}
+
     def __init__(self, name, help_text, labels=None):
         self.name = name
         self.help = help_text
@@ -85,16 +88,21 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self):
-        return [(self.name, self.labels, self._value)]
+        with self._lock:
+            return [(self.name, self.labels, self._value)]
 
 
 class Gauge:
     """Settable value, or callback-backed (``fn``) read at render time."""
 
     kind = "gauge"
+
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    _bqtpu_guarded_ = {"_lock": ("_value",)}
 
     def __init__(self, name, help_text, labels=None, fn=None):
         self.name = name
@@ -124,7 +132,8 @@ class Gauge:
                 # a gauge callback must never break rendering (e.g. psutil
                 # gone, device probe raising); NaN marks it unreadable
                 return float("nan")
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self):
         return [(self.name, self.labels, self.value)]
@@ -140,6 +149,9 @@ class Histogram:
     vectors are identical — the lint's merge precondition."""
 
     kind = "histogram"
+
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    _bqtpu_guarded_ = {"_lock": ("_counts", "_sum")}
 
     def __init__(self, name, help_text, labels=None, buckets=LATENCY_BUCKETS_S):
         self.name = name
@@ -165,11 +177,13 @@ class Histogram:
 
     @property
     def count(self):
-        return sum(self._counts)
+        with self._lock:
+            return sum(self._counts)
 
     @property
     def sum(self):
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def snapshot(self):
         """JSON-safe state: {"buckets", "counts", "sum"} (counts non-cumulative)."""
@@ -205,6 +219,9 @@ class MetricsRegistry:
     into families for rendering.  All mutating/creating calls are
     lock-protected; the hot path (a created metric's ``inc``/``observe``)
     takes only the metric's own lock."""
+
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    _bqtpu_guarded_ = {"_lock": ("_metrics", "_families")}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -271,12 +288,15 @@ class MetricsRegistry:
     # -- rendering ----------------------------------------------------------
     def render(self):
         """Prometheus text exposition format v0.0.4."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            families = dict(self._families)
         by_family = {}
-        for metric in self.metrics():
+        for metric in metrics:
             by_family.setdefault(metric.name, []).append(metric)
         lines = []
         for name in sorted(by_family):
-            kind, help_text = self._families[name]
+            kind, help_text = families[name]
             lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {kind}")
             for metric in by_family[name]:

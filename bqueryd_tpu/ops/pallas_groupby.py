@@ -366,3 +366,19 @@ def onehot_rows_dot(codes, rows, n_rows, n_groups, interpret=False):
     )
     with _enable_x64(False):
         return _call(codes_p, rows_p, rpad, gpad, interpret)
+
+
+# compile/call accounting (obs.profile): the Pallas entry points land in the
+# same jit-cache hit/miss counters and compile-seconds histogram as the XLA
+# paths — the purity lint's jit-uninstrumented rule cross-checks this.  The
+# wrapper passes straight through when called under an outer trace (the
+# use_pallas route inside _partial_tables_mm), so instrumenting here never
+# double-counts.
+from bqueryd_tpu.obs import profile as _obsprofile  # noqa: E402
+
+onehot_rows_dot = _obsprofile.instrument(
+    "ops.pallas_onehot", onehot_rows_dot
+)
+onehot_rows_dot_hicard = _obsprofile.instrument(
+    "ops.pallas_onehot_hicard", onehot_rows_dot_hicard
+)

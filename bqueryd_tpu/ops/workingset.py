@@ -61,6 +61,7 @@ _DEFAULT_BUDGETS = {
 def _budget(segment):
     env, default = _DEFAULT_BUDGETS[segment]
     try:
+        # bqtpu: allow[config-dynamic-env-key] keys come from _DEFAULT_BUDGETS above; all three are in ENV_REGISTRY
         return int(os.environ.get(env, default))
     except ValueError:
         import logging
@@ -89,6 +90,12 @@ class WorkingSet:
     """Named LRU cache segments + the device-memory-pressure eviction policy
     (module docstring)."""
 
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    #: (lock-unguarded-attr).  ``_segments`` is read-only after __init__
+    #: (the per-segment caches carry their own locks), so only the
+    #: pressure-eviction counter is guarded.
+    _bqtpu_guarded_ = {"_pressure_lock": ("pressure_evictions",)}
+
     def __init__(self, budgets=None):
         import threading
 
@@ -115,7 +122,8 @@ class WorkingSet:
         out = {
             name: cache.stats() for name, cache in self._segments.items()
         }
-        out["pressure_evictions"] = self.pressure_evictions
+        with self._pressure_lock:
+            out["pressure_evictions"] = self.pressure_evictions
         return out
 
     # -- memory pressure -----------------------------------------------------

@@ -161,6 +161,10 @@ class StageClock:
     the measurement: ``overlap = busy(host stages) / wall`` > the serial
     share proves stages ran concurrently."""
 
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    #: (lock-unguarded-attr)
+    _bqtpu_guarded_ = {"_lock": ("_busy", "_calls")}
+
     def __init__(self):
         self._lock = threading.Lock()
         self._busy = {}    # stage -> seconds

@@ -38,6 +38,7 @@ DUPLICATE = "duplicate"
 
 def _env_int(name, default):
     try:
+        # bqtpu: allow[config-dynamic-env-key] callers pass the three literal BQUERYD_TPU_ADMIT_* names below; all in ENV_REGISTRY
         return int(os.environ.get(name, default))
     except ValueError:
         return default

@@ -20,6 +20,16 @@ class BytesCappedCache:
     instance across request threads.
     """
 
+    #: lock discipline, statically checked by bqueryd_tpu.analysis
+    #: (rule lock-unguarded-attr): these attributes may only be touched
+    #: inside ``with self._lock`` (or in ``*_locked`` helpers)
+    _bqtpu_guarded_ = {
+        "_lock": (
+            "_data", "_sizes", "_bytes",
+            "hits", "misses", "evictions", "rejected",
+        ),
+    }
+
     def __init__(self, max_bytes, sizeof=lambda v: v.nbytes):
         self.max_bytes = int(max_bytes)
         self._sizeof = sizeof
@@ -106,10 +116,12 @@ class BytesCappedCache:
 
     @property
     def nbytes(self):
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def __len__(self):
-        return len(self._data)
+        with self._lock:
+            return len(self._data)
 
     def __contains__(self, key):
         with self._lock:
