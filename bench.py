@@ -744,14 +744,22 @@ def main():
                 # finally restores every prior after the whole loop
                 os.environ.pop(vflag, None)
 
-        # planner config: the adaptive (plan-driven) route vs the static
-        # fan-out (BQUERYD_TPU_PLANNER=0) on the headline + highcard
-        # configs — the main-loop numbers ARE the adaptive route (planner
-        # on by default) — plus a plan-time pruning probe whose filter no
-        # shard can match: the counter must move and no dispatch may occur.
+        # planner config: the adaptive (plan-driven, calibration-fed) route
+        # vs the static fan-out (BQUERYD_TPU_PLANNER=0) on the headline +
+        # highcard configs — the main-loop numbers ARE the adaptive route
+        # (planner on by default) — plus per-config regret accounting
+        # (adaptive wall minus the best measured static wall, including the
+        # forced-matmul route where that route is legal), the strategy the
+        # workers actually compiled, and a plan-time pruning probe whose
+        # filter no shard can match.
         planner_detail = {}
         if os.environ.get("BENCH_PLANNER", "1") == "1" and not wedged:
             controller_node = nodes[0]
+            # the matmul route's backend guard: on CPU backends (no
+            # FORCE_MATMUL here — bench pops it) forced-matmul is not a
+            # legal static route, so it never enters best-static and the
+            # regret gate compares adaptive vs plain static only
+            matmul_legal = jax.default_backend() != "cpu"
             for pcfg in ("sharded", "highcard"):
                 if pcfg not in completed:
                     continue
@@ -767,10 +775,17 @@ def main():
                     # static compile to the SAME program on backends that
                     # normalize hints, so the comparison is noise-bounded —
                     # a loose min reads scheduler jitter as a route delta
+                    a_strategies = None
                     for _ in range(max(REPEATS, 5)):
                         t0 = time.perf_counter()
                         a_result = rpc.groupby(files, gcols, aggs, where)
                         a_walls.append(time.perf_counter() - t0)
+                        # captured INSIDE the loop: after the interleave the
+                        # client's last_call_strategies belongs to the
+                        # static (PLANNER=0) run, whose hints are all auto
+                        a_strategies = getattr(
+                            rpc, "last_call_strategies", None
+                        )
                         os.environ["BQUERYD_TPU_PLANNER"] = "0"
                         try:
                             t0 = time.perf_counter()
@@ -795,6 +810,29 @@ def main():
                         flush=True,
                     )
                     continue
+                # what the workers actually compiled for the last adaptive
+                # repeat (effective_strategy, satellite: hints used to
+                # normalize silently and nothing could tell what ran)
+                strategies = a_strategies or {}
+                effective = [
+                    v for v in (strategies.get("effective") or {}).values()
+                ]
+                chosen = (
+                    max(set(effective), key=effective.count)
+                    if effective else None
+                )
+                from bqueryd_tpu.plan import calibrate as calibrate_mod
+
+                calib_stats = calibrate_mod.store().stats()
+                forced_wall = results.get(
+                    f"{pcfg}_forced_matmul", {}
+                ).get("framework_wall_s")
+                # best measured STATIC route: the PLANNER=0 wall always;
+                # the forced-matmul wall only where that route is legal
+                static_routes = {"static": static_wall}
+                if forced_wall is not None and matmul_legal:
+                    static_routes["forced_matmul"] = forced_wall
+                best_static = min(static_routes.values())
                 planner_detail[pcfg] = {
                     "adaptive_wall_s": round(adaptive_wall, 4),
                     "main_loop_wall_s": results[pcfg]["framework_wall_s"],
@@ -802,13 +840,29 @@ def main():
                     # the forced-matmul variant wall (measured above when the
                     # route flag applies): the regression the planner path
                     # must keep unreachable
-                    "forced_matmul_wall_s": results.get(
-                        f"{pcfg}_forced_matmul", {}
-                    ).get("framework_wall_s"),
+                    "forced_matmul_wall_s": forced_wall,
+                    "chosen_strategy": chosen,
+                    "strategy_hints": dict(strategies.get("hints") or {}),
+                    "calibration_samples": calib_stats["samples_total"],
+                    "calibration_cells": calib_stats["cells"],
+                    # regret: adaptive wall minus the best measured static
+                    # wall (negative = the calibrated route beat every
+                    # static one); the gate below asserts <= 10% wherever
+                    # the matmul route is legal
+                    "best_static_wall_s": round(best_static, 4),
+                    "regret_s": round(adaptive_wall - best_static, 4),
+                    "regret_gate_applies": matmul_legal,
+                    "regret_within_10pct": bool(
+                        adaptive_wall <= 1.10 * best_static
+                    ),
                 }
                 print(
                     f"[bench] planner {pcfg}: adaptive {adaptive_wall:.3f}s "
-                    f"vs static {static_wall:.3f}s",
+                    f"vs static {static_wall:.3f}s "
+                    f"(best static {best_static:.3f}s, regret "
+                    f"{adaptive_wall - best_static:+.3f}s, chosen "
+                    f"{chosen}, {calib_stats['samples_total']} calibration "
+                    f"samples)",
                     file=sys.stderr,
                     flush=True,
                 )
@@ -848,13 +902,32 @@ def main():
                 )
             planner_detail["plan_counters"] = dict(controller_node.counters)
             planner_detail["note"] = (
-                "on this backend every planner hint normalizes to the same "
-                "compiled program as the static route (executor."
-                "_effective_mesh_strategy), so adaptive-vs-static wall "
-                "deltas are run-to-run noise; the planner's wins here are "
-                "pruning (prune_probe) and never taking the forced-matmul "
-                "route"
+                "adaptive = calibration-fed planner (measured kernel walls "
+                "refine the heuristic; matmul promotions bind only inside "
+                "the kernel guards).  On CPU backends the matmul route is "
+                "not legal (backend guard, forced_matmul excluded from "
+                "best_static) and surviving hints normalize to the static "
+                "program, so regret there is run-to-run noise; the regret "
+                "gate certifies adaptive <= 1.10x best-static wherever the "
+                "matmul route IS legal"
             )
+            # THE GATE (satellite): adaptive must stay within 10% of the
+            # best measured static route on every config where the matmul
+            # route is legal — the calibrated planner may never leave the
+            # forced-matmul-sized win on the table again.  BENCH_PLANNER_
+            # GATE=0 records without asserting (probe runs).
+            if os.environ.get("BENCH_PLANNER_GATE", "1") == "1":
+                for pcfg, entry in planner_detail.items():
+                    if not isinstance(entry, dict):
+                        continue
+                    if not entry.get("regret_gate_applies"):
+                        continue
+                    assert entry.get("regret_within_10pct"), (
+                        f"planner regret gate: {pcfg} adaptive "
+                        f"{entry['adaptive_wall_s']}s exceeds 1.10x best "
+                        f"static {entry['best_static_wall_s']}s "
+                        f"(regret {entry['regret_s']}s)"
+                    )
 
         # observability: registry snapshots bracket a headline groupby wall
         # (perf regressions come with phase attribution for free — the
@@ -1382,6 +1455,12 @@ def main():
                         "plan_pruned_shards": planner_detail.get(
                             "plan_counters", {}
                         ).get("plan_pruned_shards"),
+                        "planner_regret_s": (
+                            planner_detail.get(HEADLINE) or {}
+                        ).get("regret_s"),
+                        "chosen_strategy": (
+                            planner_detail.get(HEADLINE) or {}
+                        ).get("chosen_strategy"),
                         "obs_overhead_pct": obs_detail.get("overhead_pct"),
                         "pipeline_speedup": pipeline_detail.get(
                             "pipeline_speedup"

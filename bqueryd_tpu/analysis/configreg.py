@@ -174,6 +174,22 @@ ENV_REGISTRY = {
            "force the MXU one-hot path on CPU backends (tests)"),
         _v("PLANNER", "flag", "1",
            "plan-time shard pruning + kernel-strategy hints (0=static)"),
+        _v("CALIB", "flag", "1",
+           "measured-cost strategy calibration feeding the planner "
+           "(0 = PR-5 heuristic hints exactly)",
+           related=("CALIB_PATH", "CALIB_EPSILON", "CALIB_MIN_SAMPLES")),
+        _v("CALIB_PATH", "path", "-",
+           "persist worker calibration cells to this JSON file across "
+           "restarts (- = in-memory only)",
+           related=("CALIB",)),
+        _v("CALIB_EPSILON", "float", "0.05",
+           "bounded exploration rate: ~every 1/eps-th warm-bucket decision "
+           "samples an unmeasured legal route (0 = off)",
+           related=("CALIB",)),
+        _v("CALIB_MIN_SAMPLES", "int", "3",
+           "measured kernel walls a strategy cell needs before calibration "
+           "trusts it",
+           related=("CALIB",)),
         _v("ADMIT_MAX_ACTIVE", "int", "64",
            "concurrent executing plans before queueing"),
         _v("ADMIT_QUEUE_DEPTH", "int", "256",

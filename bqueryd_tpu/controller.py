@@ -70,6 +70,12 @@ COUNTER_SPECS = {
     "plan_pruned_shards": "shards excluded at plan time by advertised stats",
     "plan_shared_dispatches": "identical concurrent work fused into one dispatch",
     "plan_strategy_hints": "non-auto kernel-strategy hints issued",
+    "plan_calibrated_overrides":
+        "dispatches where measured walls overrode the heuristic route",
+    "plan_explore_hints":
+        "bounded-exploration dispatches sampling an unmeasured route",
+    "plan_matmul_promotions":
+        "calibration-backed matmul hints made binding inside the guards",
     "admission_busy": "BUSY backpressure replies sent to clients",
     "admission_queued": "plans held in the admission wait queue",
     "admission_superseded": "abandoned queries retired early on resend",
@@ -144,6 +150,13 @@ class ControllerNode:
         self._admitting = False
         self._ticket_sigs = {}        # live ticket -> plan signature
         self.shard_stats = {}         # filename -> advertised planning stats
+        # measured-cost strategy calibration: WRM `calibration` summaries
+        # from workers merge into this model (plan.calibrate), consulted by
+        # select_calibrated at dispatch time; in-memory only — the workers
+        # own persistence (their measurements re-gossip after a restart)
+        from bqueryd_tpu.plan import calibrate as _calibrate
+
+        self.calibration = _calibrate.CalibrationStore()
         self._work_subscribers = {}   # shard token -> [parent_token, ...]
         self._work_keys = {}          # shard token -> shared-dispatch key
         self._work_index = {}         # shared-dispatch key -> shard token
@@ -492,15 +505,30 @@ class ControllerNode:
         version-skewed or buggy worker) must poison at most its own shard's
         stats, never a query — downstream consumers assume dicts."""
         stats = info.get("shard_stats")
-        if not isinstance(stats, dict):
-            return
-        for fname, entry in stats.items():
-            if (
-                isinstance(fname, str)
-                and isinstance(entry, dict)
-                and isinstance(entry.get("cols", {}), dict)
-            ):
-                self.shard_stats[fname] = entry
+        if isinstance(stats, dict):
+            for fname, entry in stats.items():
+                if (
+                    isinstance(fname, str)
+                    and isinstance(entry, dict)
+                    and isinstance(entry.get("cols", {}), dict)
+                ):
+                    self.shard_stats[fname] = entry
+        # measured-cost calibration gossip rides the same WRM; absorb is
+        # per-cell defensive (plan.calibrate), so a skewed peer degrades to
+        # contributing nothing rather than poisoning the model.  source=
+        # makes each worker's cumulative summary REPLACE its previous one
+        # instead of re-merging every heartbeat (sample double-counting)
+        calibration = info.get("calibration")
+        if isinstance(calibration, dict):
+            try:
+                self.calibration.absorb(
+                    calibration,
+                    source=info.get("worker_id") or "unidentified-worker",
+                )
+            except Exception:
+                self.logger.debug(
+                    "calibration gossip absorb failed", exc_info=True
+                )
 
     # -- scheduling --------------------------------------------------------
     def find_free_worker(self, needs_local=False, filename=None):
@@ -1011,6 +1039,9 @@ class ControllerNode:
             delivered = True
             segment["results"][key] = msg.get("data") or b""
             segment["timings"][key] = msg.get("phase_timings")
+            effective = msg.get("effective_strategy")
+            if isinstance(effective, str):
+                segment.setdefault("effective", {})[key] = effective
             # worker-side spans (calc root + phases) fold into the timeline;
             # shared dispatches land on every subscriber's segment
             spans = msg.get("spans")
@@ -1050,7 +1081,20 @@ class ControllerNode:
         # log tails keep intact); same labelling as the slow-query log
         timings = self._compact_timings(segment["timings"])
         reply = pickle.dumps(
-            {"ok": True, "payloads": payloads, "timings": timings},
+            {
+                "ok": True,
+                "payloads": payloads,
+                "timings": timings,
+                # planner visibility end to end: the hints issued and the
+                # routes the workers actually compiled post-guards (bench's
+                # chosen_strategy / regret accounting read these)
+                "strategies": {
+                    "hints": dict(segment.get("strategies", {})),
+                    "effective": self._compact_timings(
+                        segment.get("effective")
+                    ),
+                },
+            },
             protocol=4,
         )
         self._finish_segment(parent, segment, reply)
@@ -1179,6 +1223,9 @@ class ControllerNode:
                 "pruned_shards": len(segment.get("pruned", ())),
                 "plan_signature": segment.get("plan_sig"),
                 "strategy_hints": dict(segment.get("strategies", {})),
+                "effective_strategies": self._compact_timings(
+                    segment.get("effective")
+                ),
                 "phase_timings": self._compact_timings(segment.get("timings")),
             },
         )
@@ -1832,6 +1879,7 @@ class ControllerNode:
             "obs": obs_state,
             "plan_sig": str(plan.signature()),
             "strategies": {},         # hint -> dispatch count
+            "effective": {},          # shard-group key -> executed route
         }
         self.rpc_segments[parent_token] = segment
         if not keep:
@@ -1865,17 +1913,26 @@ class ControllerNode:
             keep, groupby_cols, agg_list, kwargs
         ):
             target = group if len(group) > 1 else group[0]
-            # cost-based kernel-strategy selection from advertised stats;
-            # "auto" (no stats / ambiguous economics) is the static default
+            # cost-based kernel-strategy selection from advertised stats,
+            # refined by measured kernel walls when the calibration model is
+            # warm (plan.calibrate; cold buckets are bit-identical to the
+            # heuristic); "auto" stays the static default
             strategy = None
             if planner_on:
-                strategy, _est, _rows = planmod.select_for_group(
-                    self.shard_stats, group, groupby_cols
+                strategy, _est, _rows, reason = planmod.select_calibrated(
+                    self.shard_stats, group, groupby_cols,
+                    calibration=self.calibration,
                 )
                 if strategy == planmod.STRATEGY_AUTO:
                     strategy = None
                 else:
                     self.counters["plan_strategy_hints"] += 1
+                if reason == "measured":
+                    self.counters["plan_calibrated_overrides"] += 1
+                elif reason == "explore":
+                    self.counters["plan_explore_hints"] += 1
+                if strategy == planmod.STRATEGY_MATMUL_BINDING:
+                    self.counters["plan_matmul_promotions"] += 1
             segment = self.rpc_segments.get(parent_token)
             if segment is not None:
                 hint = strategy or "auto"

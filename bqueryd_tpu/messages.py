@@ -41,7 +41,8 @@ without them interoperate):
   don't know): ``backend_wedged`` (bool, the device-health latch),
   ``work_errors`` (cumulative error-counter total — the controller's
   health scorer derives windowed error rates from its deltas),
-  ``metrics`` (histogram snapshot, see obs.metrics), and ``debug`` — the
+  ``metrics`` (histogram snapshot, see obs.metrics), ``calibration`` (the
+  worker's measured-cost strategy cells, see plan.calibrate), and ``debug`` — the
   node's debug-bundle slice (flight-ring tail, compile registry, device
   health, runtime versions; see obs.flightrec) absorbed controller-side
   so ``rpc.debug_bundle()`` can speak for dead peers.
@@ -93,7 +94,11 @@ ENVELOPE_SCHEMA = {
     "phase_timings": "per-phase seconds dict; whole-call wall under _total",
     "spans": "worker span list folded into the query trace timeline",
     "deadline_remaining": "seconds left at reply serialization",
-    "strategy": "kernel strategy the worker actually executed",
+    "strategy": "the planner's kernel-strategy hint, echoed on the reply",
+    "effective_strategy": "physical kernel route the worker ran post-guards "
+                          "(matmul/scatter/sort/host; 'cached' = result-"
+                          "cache hit, nothing compiled) — hints may "
+                          "normalize",
     "error": "failure detail on error/ticketdone paths",
     "result": "base64-pickled rpc verb return value",
     # worker register messages (WRM heartbeats)
@@ -109,6 +114,8 @@ ENVELOPE_SCHEMA = {
     "work_errors": "cumulative error-counter total (health windows)",
     "debug": "node debug-bundle slice (flight tail, compile registry, ...)",
     "shard_stats": "per-shard planning stats (rows, min/max, cardinality)",
+    "calibration": "measured-cost strategy calibration summary "
+                   "(plan.calibrate cells, absorbed controller-side)",
     "metrics": "histogram snapshot (bucket-vector mergeable)",
     "liveness_only": "heartbeat-thread WRM: skip data_files rescan",
     # controller gossip + bookkeeping riders
@@ -131,6 +138,8 @@ RESULT_ENVELOPE_SCHEMA = {
     "busy": "admission BUSY backpressure marker (RPCBusyError client-side)",
     "payloads": "per-shard-group ResultPayload byte strings",
     "timings": "compacted per-phase timing summary",
+    "strategies": "planner report: {hints: hint->dispatches, effective: "
+                  "shard-group->executed kernel route}",
     "error": "failure reason when ok is False",
 }
 
@@ -156,7 +165,7 @@ WIRE_ONE_SIDED_OK = {
     "_obs": "controller-internal rider, intentionally unread elsewhere",
     "deadline_remaining": "informational reply field for clients/tests; "
                           "the controller deliberately ignores it",
-    "strategy": "informational reply field (executed kernel strategy) for "
+    "strategy": "informational reply field (the hint echo) for "
                 "clients/tests; dispatch accounting happens at send time",
     "others": "written into get_info(); read by rpc.info() clients/tests",
     "ip": "operator-facing WRM field surfaced via rpc.info(); the "

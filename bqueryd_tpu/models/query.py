@@ -441,6 +441,10 @@ class QueryEngine:
 
     def __init__(self, timer=None):
         self.timer = timer
+        #: the physical kernel route of the last execute_local (post-guards;
+        #: "host" for host-routed queries) — surfaced by the worker as
+        #: ``effective_strategy`` in calc replies and kernel trace spans
+        self.last_effective_strategy = None
         from bqueryd_tpu.utils.cache import BytesCappedCache
 
         # per-(table, column) factorization cache: the host analogue of
@@ -559,6 +563,7 @@ class QueryEngine:
         beats planning."""
         from bqueryd_tpu import ops
 
+        self.last_effective_strategy = None  # set by the kernel dispatch
         if query.aggregate:
             # reject pandas-meaningless datetime sums/means before any
             # decode/factorize work is spent on the query
@@ -692,28 +697,72 @@ class QueryEngine:
                     # beats the device's dispatch+fetch floor (see
                     # host_kernel_rows); identical partial semantics.  The
                     # planner's "host" hint forces this branch outright.
+                    import time as _time
+
+                    from bqueryd_tpu.plan import calibrate as _calibrate
+
+                    self.last_effective_strategy = "host"
+                    host_clock = _time.perf_counter()
                     partials = ops.host_partial_tables(
                         dense.astype(np.int32), measures, mops, n_groups,
                         mask_arr, null_sentinels=sentinels,
                     )
+                    # host walls are calibration data points too (no
+                    # compile taint to filter on this route)
+                    _calibrate.record_sample(
+                        rows=len(dense), groups=n_groups,
+                        dtypes=[np.asarray(m).dtype for m in measures],
+                        backend="host", strategy="host",
+                        wall_s=_time.perf_counter() - host_clock,
+                    )
                 else:
+                    import time as _time
+
                     import jax
+
+                    from bqueryd_tpu.obs import profile as _obs_profile
+                    from bqueryd_tpu.plan import calibrate as _calibrate
 
                     # bucketed group count (ops.program_bucket): program
                     # reuse across cardinality drift; padded groups are
                     # zero-row and sliced off after the fetch
                     n_prog = ops.program_bucket(n_groups)
+                    kernel_strategy = (
+                        strategy
+                        if strategy in ("matmul", "scatter", "sort",
+                                        "matmul!")
+                        else None
+                    )
+                    np_measures = [np.asarray(m) for m in measures]
+                    route = ops.kernel_route(
+                        kernel_strategy, np_measures, mops,
+                        len(dense), n_prog,
+                    )
+                    self.last_effective_strategy = route
+                    profiler = _obs_profile.profiler()
+                    misses_before = profiler.jit_cache_misses
+                    kernel_clock = _time.perf_counter()
                     partials = jax.device_get(  # ONE batched D2H round-trip
                         ops.partial_tables(
                             dense.astype(np.int32), measures, mops, n_prog,
                             mask_arr, null_sentinels=sentinels,
-                            strategy=(
-                                strategy
-                                if strategy in ("matmul", "scatter", "sort")
-                                else None
-                            ),
+                            strategy=kernel_strategy,
                         )
                     )
+                    # measured-cost calibration sample (plan.calibrate);
+                    # compile-tainted walls are skipped — a first-shape
+                    # compile would poison the route's EWMA
+                    if (
+                        _calibrate.enabled()
+                        and profiler.jit_cache_misses == misses_before
+                    ):
+                        _calibrate.record_sample(
+                            rows=len(dense), groups=n_groups,
+                            dtypes=[m.dtype for m in np_measures],
+                            backend=jax.default_backend(),
+                            strategy=route,
+                            wall_s=_time.perf_counter() - kernel_clock,
+                        )
                     if n_prog != n_groups:
                         partials = jax.tree_util.tree_map(
                             lambda a: a[:n_groups], partials

@@ -130,6 +130,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     groupby_sorted_count_distinct,
     host_partial_tables,
     host_sorted_count_distinct,
+    kernel_route,
     partial_tables,
     program_bucket,
     psum_partials,
@@ -158,6 +159,7 @@ __all__ = [
     "expand_mask_by_group",
     "host_partial_tables",
     "host_sorted_count_distinct",
+    "kernel_route",
     "partial_tables",
     "program_bucket",
     "combine_partials",

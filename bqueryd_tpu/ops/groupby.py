@@ -131,7 +131,11 @@ _MAX_BLOCK_SEGMENTS = 1 << 25
 #: kernel routes partial_tables accepts as a planner hint (None == "auto").
 #: "matmul" is advisory — every profitability/backend guard still applies —
 #: while "scatter"/"sort" are binding (both are always-correct fallbacks).
-KERNEL_STRATEGIES = ("auto", "matmul", "scatter", "sort")
+#: "matmul!" is the CALIBRATION-BACKED form: binding inside the guards —
+#: it bypasses only the op/dtype profitability heuristic, while the backend
+#: guard and the groups/cells value guards (matmul_route_allowed) stand, so
+#: the forced-matmul regression stays unreachable through any hint.
+KERNEL_STRATEGIES = ("auto", "matmul", "scatter", "sort", "matmul!")
 
 
 def _sorted_segment_sum(values, safe, n_groups, acc_dtype=jnp.int64):
@@ -258,23 +262,32 @@ def _matmul_cells_limit():
     return int(os.environ.get("BQUERYD_TPU_MATMUL_CELLS", 1 << 36))
 
 
-def _matmul_profitable(measures, ops, n, n_groups):
-    """MXU path only when within budget AND some sum/count actually rides the
-    matmul (min/max and float64 sums scatter regardless, so a query made only
-    of those gains nothing from building the one-hot)."""
+def matmul_route_allowed(n, n_groups):
+    """The MXU route's SAFETY guards, shared by the adaptive dispatcher and
+    the calibration-backed binding hint: backend (the one-hot contraction
+    emulates ~7x slower than the int32 scatter on CPU backends —
+    BQUERYD_TPU_FORCE_MATMUL=1 overrides, pinned by the test suite for
+    MXU-path coverage on the CPU test backend), group ceiling, and the
+    rows x groups cells budget.  A "matmul!" hint that fails ANY of these
+    demotes to the adaptive default — only the op/dtype profitability
+    heuristic below yields to measurement."""
     if (
         jax.default_backend() == "cpu"
         and os.environ.get("BQUERYD_TPU_FORCE_MATMUL") != "1"
     ):
-        # the one-hot bf16 contraction exists for the systolic array; on a
-        # CPU backend it emulates ~7x slower than the int32 scatter
-        # (measured at 10M rows x 9 groups).  BQUERYD_TPU_FORCE_MATMUL=1
-        # overrides (the test suite pins it to keep MXU-path coverage on
-        # the CPU test backend); the groups knob stays purely value-based.
         return False
     if not (0 < n_groups <= matmul_groups_limit()):
         return False
     if n * n_groups > _matmul_cells_limit():
+        return False
+    return True
+
+
+def _matmul_profitable(measures, ops, n, n_groups):
+    """MXU path only when within budget AND some sum/count actually rides the
+    matmul (min/max and float64 sums scatter regardless, so a query made only
+    of those gains nothing from building the one-hot)."""
+    if not matmul_route_allowed(n, n_groups):
         return False
     x64 = bool(jax.config.jax_enable_x64)
     for values, op in zip(measures, ops):
@@ -387,6 +400,21 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
             codes, measures, ops, int(n_groups), mask,
             null_sentinels=null_sentinels, force_sort=True,
         )
+    if strategy == "matmul!" and matmul_route_allowed(
+        int(codes.shape[0]), int(n_groups)
+    ):
+        # calibration-backed promotion: measurement overrides only the
+        # op/dtype profitability heuristic — backend + value guards were
+        # just enforced (a failed guard falls through to the adaptive
+        # dispatch below, exactly as if the hint were advisory)
+        from bqueryd_tpu.ops import pallas_groupby
+
+        return _partial_tables_mm(
+            codes, measures, ops, int(n_groups), mask,
+            use_pallas=pallas_groupby.pallas_enabled()
+            and int(n_groups) <= pallas_groupby.pallas_groups_limit(),
+            null_sentinels=null_sentinels,
+        )
     if _matmul_profitable(measures, ops, int(codes.shape[0]), int(n_groups)):
         # env flags are read HERE, outside jit, so toggling them takes effect
         # per call instead of being frozen into the first trace
@@ -413,6 +441,32 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
         codes, measures, ops, int(n_groups), mask,
         null_sentinels=null_sentinels,
     )
+
+
+def kernel_route(strategy, measures, ops, n, n_groups):
+    """Predict the physical route :func:`partial_tables` takes for this
+    dispatch WITHOUT running it — the ``effective_strategy`` reported in
+    calc replies / kernel trace spans and the label calibration samples are
+    recorded under.  Mirrors the dispatch above; ``measures`` only needs
+    ``.dtype`` per entry (device arrays, numpy arrays, and dtype stubs all
+    work).  Granularity note: the rare in-kernel demotions (a hicard Pallas
+    plan that fails its VMEM recheck at trace time) are not modelled —
+    those differ per XLA trace, not per dispatch."""
+    n, n_groups = int(n), int(n_groups)
+    if strategy == "scatter":
+        return "scatter"
+    if strategy == "sort":
+        return "sort"
+    if strategy == "matmul!" and matmul_route_allowed(n, n_groups):
+        return "matmul"
+    if _matmul_profitable(measures, tuple(ops), n, n_groups):
+        return "matmul"
+    if _hicard_matmul_profitable(measures, tuple(ops), n, n_groups):
+        return "matmul"
+    blocks = -(-n // _SUM_BLOCK)
+    if blocks * n_groups > _MAX_BLOCK_SEGMENTS:
+        return "sort"
+    return "scatter"
 
 
 def _segment_extremum(kind, values, present, safe, n_groups):

@@ -191,6 +191,10 @@ class MeshQueryExecutor:
         self.axis_name = axis_name
         self.timer = timer
         self._align_engine = None
+        #: the physical kernel route the last execute() dispatched
+        #: (post-guards) — the worker surfaces it as ``effective_strategy``
+        #: in calc replies and the ``kernel`` trace span
+        self.last_effective_strategy = None
         from bqueryd_tpu.ops.workingset import WorkingSet
 
         # the device-resident working-set layer (ops/workingset.py): LRU
@@ -482,6 +486,7 @@ class MeshQueryExecutor:
         None/"auto" keeps the dispatcher's own adaptive choice."""
         from bqueryd_tpu import ops
 
+        self.last_effective_strategy = None  # set at the kernel dispatch
         if strategy in (None, "auto", "host"):
             # "host" is meaningless inside a mesh program; the worker should
             # not have routed such a query here, but degrade to auto rather
@@ -517,6 +522,9 @@ class MeshQueryExecutor:
             ]
         if not tables:
             return ResultPayload.empty()
+        # calibration buckets key on the dispatch group's total rows — the
+        # same quantity the controller's selector estimated from stats
+        total_rows = sum(int(t.nrows) for t in tables)
 
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -699,12 +707,27 @@ class MeshQueryExecutor:
             # reuse across cardinality drift, ops.program_bucket); padded
             # groups have zero rows and are sliced off right below, on host
             n_prog = ops.program_bucket(n_groups)
+            # the physical route this dispatch takes post-guards: reported
+            # as effective_strategy and the label calibration samples land
+            # under (hints silently normalized here until this existed —
+            # neither traces nor bench could tell what actually ran)
+            per_agg_d = tuple(measures_d[i] for i in measure_index)
+            route = ops.kernel_route(
+                strategy, per_agg_d, tuple(query.ops),
+                int(codes_d.shape[1]), n_prog,
+            )
+            self.last_effective_strategy = route
+            from bqueryd_tpu.obs import profile as obs_profile
+
+            profiler = obs_profile.profiler()
             # tunneled backends surface transient remote-compile INTERNAL
             # errors (HTTP 500 compile-helper crashes observed on hardware,
             # TPU_VALIDATE_r5_prefix.json case7/case13): one retry keeps
             # the on-device merge path; a second failure propagates to the
             # worker, which degrades to the per-shard engine path
             for attempt in range(2):
+                misses_before = profiler.jit_cache_misses
+                kernel_clock = time.perf_counter()
                 try:
                     merged = _mesh_partials(
                         mesh, self.axis_name, query.ops, n_prog,
@@ -713,6 +736,7 @@ class MeshQueryExecutor:
                         strategy=strategy,
                         measure_index=measure_index,
                     )
+                    kernel_wall = time.perf_counter() - kernel_clock
                     break
                 except jax.errors.JaxRuntimeError as exc:
                     # deterministic failures (INVALID_ARGUMENT, device OOM)
@@ -722,6 +746,24 @@ class MeshQueryExecutor:
                     if attempt or not _transient_status(exc):
                         raise
                     time.sleep(0.5)
+            # measured-cost calibration sample (the planner feedback loop):
+            # walls tainted by a jit compile are skipped — a 20 s compile
+            # inside a 4 ms kernel wall would poison the route's EWMA
+            from bqueryd_tpu.plan import calibrate
+
+            if (
+                calibrate.enabled()
+                and profiler.jit_cache_misses == misses_before
+            ):
+                prog = profiler.last_program("executor.mesh_program")
+                calibrate.record_sample(
+                    rows=total_rows, groups=n_groups,
+                    dtypes=[m.dtype for m in per_agg_d],
+                    backend=jax.default_backend(),
+                    strategy=route, wall_s=kernel_wall,
+                    flops=(prog or {}).get("flops"),
+                    bytes_accessed=(prog or {}).get("bytes_accessed"),
+                )
             if n_prog != n_groups:
                 import jax as _jax
 
@@ -948,8 +990,11 @@ def _effective_mesh_strategy(strategy, agg_ops, n_groups, measures_d, width):
     that cannot change the traced route must key (and trace) exactly like
     ``auto``, or an identical program would be compiled twice — a "matmul"
     hint is advisory by definition (the dispatcher decides identically under
-    auto), and a "scatter" hint is a no-op whenever auto would scatter
-    anyway (always on CPU backends, and past the matmul group ceiling)."""
+    auto), a "scatter" hint is a no-op whenever auto would scatter anyway
+    (always on CPU backends, and past the matmul group ceiling), and the
+    calibration-backed "matmul!" normalizes to auto both when auto already
+    takes the MXU route (identical program) and when the kernel guards
+    would demote it (backend/value guards stand under promotion)."""
     if strategy in (None, "auto", "matmul"):
         return None
     from bqueryd_tpu.ops import groupby as gb
@@ -959,6 +1004,10 @@ def _effective_mesh_strategy(strategy, agg_ops, n_groups, measures_d, width):
     ) or gb._hicard_matmul_profitable(
         measures_d, agg_ops, width, int(n_groups)
     )
+    if strategy == "matmul!":
+        if mm or not gb.matmul_route_allowed(width, int(n_groups)):
+            return None
+        return strategy
     if strategy == "scatter" and not mm:
         return None
     if strategy == "sort" and not mm:

@@ -10,6 +10,10 @@ Four pieces, all control-plane safe (no JAX, no pandas):
   registration messages, and the stats-only shard pruning predicate;
 * :mod:`bqueryd_tpu.plan.strategy`  — cost-based kernel-route selection
   (scatter vs sort+prefix-diff vs MXU limb-matmul) from those stats;
+* :mod:`bqueryd_tpu.plan.calibrate` — measured-cost calibration of that
+  selection: per-(rows, groups, dtype, backend, strategy) kernel walls
+  recorded by workers, gossiped in WRMs, refined online
+  (``BQUERYD_TPU_CALIB=0`` restores the pure heuristic);
 * :mod:`bqueryd_tpu.plan.admission` — bounded priority admission queue with
   per-client quotas, deadlines, and explicit BUSY backpressure.
 
@@ -43,10 +47,14 @@ from bqueryd_tpu.plan.stats import (  # noqa: F401
 from bqueryd_tpu.plan.strategy import (  # noqa: F401
     STRATEGIES,
     STRATEGY_AUTO,
+    STRATEGY_MATMUL_BINDING,
+    candidate_strategies,
     choose_strategy,
     estimate_groups,
+    select_calibrated,
     select_for_group,
 )
+from bqueryd_tpu.plan import calibrate  # noqa: F401
 
 
 def planner_enabled():

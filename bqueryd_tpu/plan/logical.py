@@ -285,7 +285,16 @@ def plan_groupby(filenames, groupby_cols, agg_list, where_terms=None,
 
 def fragment_for(plan, filenames, strategy=None, sole=False):
     """The per-dispatch slice of a plan: what ONE CalcMessage executes.
-    Travels as the message's ``plan`` binary field (pickled, like params)."""
+    Travels as the message's ``plan`` binary field (pickled, like params).
+
+    The calibration-backed binding promotion ("matmul!") deliberately never
+    rides the wire as a strategy VALUE: pre-calibration workers would
+    reject the unknown literal at the kernel (``KERNEL_STRATEGIES``
+    validation) and fail the query.  It ships as the advisory "matmul"
+    plus a separate ``strategy_binding`` flag — old workers ignore the
+    unknown key and degrade to the advisory semantics, which is exactly
+    the mixed-version contract MIGRATION.md promises."""
+    binding = strategy == "matmul!"
     return {
         "v": PLAN_VERSION,
         "filenames": list(filenames),
@@ -295,7 +304,8 @@ def fragment_for(plan, filenames, strategy=None, sole=False):
         "aggregate": bool(plan.aggregate_rows),
         "expand_filter_column": plan.expand_filter_column,
         "sole": bool(sole),
-        "strategy": strategy,
+        "strategy": "matmul" if binding else strategy,
+        "strategy_binding": binding,
     }
 
 

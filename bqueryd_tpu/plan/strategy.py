@@ -42,10 +42,16 @@ STRATEGY_HOST = "host"
 STRATEGY_MATMUL = "matmul"
 STRATEGY_SCATTER = "scatter"
 STRATEGY_SORT = "sort"
+#: calibration-backed matmul: binding INSIDE the kernel guards — the worker
+#: skips only the op/dtype profitability heuristic, while the backend guard
+#: and the ``matmul_groups_limit``/``matmul_cells_limit`` value guards stand
+#: (so the forced-matmul regression stays unreachable).  Only emitted by
+#: :func:`select_calibrated` when measurement backs the matmul route.
+STRATEGY_MATMUL_BINDING = "matmul!"
 
 STRATEGIES = (
     STRATEGY_AUTO, STRATEGY_HOST, STRATEGY_MATMUL, STRATEGY_SCATTER,
-    STRATEGY_SORT,
+    STRATEGY_SORT, STRATEGY_MATMUL_BINDING,
 )
 
 #: mirrors ops.groupby._SUM_BLOCK / _MAX_BLOCK_SEGMENTS: the blocked scatter
@@ -127,10 +133,13 @@ def choose_strategy(total_rows, est_groups):
 
 
 def select_for_group(stats_by_file, filenames, groupby_cols):
-    """Controller entry point: strategy hint for one dispatch group.
-    Returns ``(strategy, est_groups, total_rows)``.  Malformed advertised
-    stats (version-skewed worker) degrade to ``auto``, never raise — a
-    stats problem must not fail the query it was meant to speed up."""
+    """Controller entry point: HEURISTIC strategy hint for one dispatch
+    group.  Returns ``(strategy, est_groups, total_rows)``.  Malformed
+    advertised stats (version-skewed worker) degrade to ``auto``, never
+    raise — a stats problem must not fail the query it was meant to speed
+    up.  This is the PR-5 behaviour, bit for bit; the calibrated layer
+    (:func:`select_calibrated`) wraps it and falls back here whenever
+    calibration is disabled or cold."""
     stats_list = [
         (stats_by_file or {}).get(f) for f in filenames
     ]
@@ -142,3 +151,62 @@ def select_for_group(stats_by_file, filenames, groupby_cols):
         return choose_strategy(total_rows, est), est, total_rows
     except (TypeError, ValueError):
         return STRATEGY_AUTO, None, None
+
+
+def candidate_strategies(total_rows, est_groups):
+    """The kernel routes LEGAL at (rows, est groups): scatter and sort are
+    always-correct fallbacks; matmul is a candidate only inside the same
+    value guards ``ops.partial_tables`` enforces (group ceiling, cells
+    budget) — calibration may only rank routes the guards would accept, so
+    a measured preference can never smuggle an illegal route past them."""
+    candidates = [STRATEGY_SCATTER, STRATEGY_SORT]
+    if (
+        est_groups is not None
+        and total_rows is not None
+        and 0 < est_groups <= matmul_groups_limit()
+        and total_rows * est_groups <= matmul_cells_limit()
+    ):
+        candidates.insert(0, STRATEGY_MATMUL)
+    return tuple(candidates)
+
+
+def select_calibrated(stats_by_file, filenames, groupby_cols,
+                      calibration=None):
+    """Measured-cost strategy selection: the heuristic choice refined by a
+    :class:`~bqueryd_tpu.plan.calibrate.CalibrationStore` when one is given
+    and warm.  Returns ``(strategy, est_groups, total_rows, reason)`` with
+    ``reason`` from ``CalibrationStore.choose`` (``cold`` also covers every
+    disabled/degraded path).  Decision ladder:
+
+    * no stats / calibration off / cold bucket -> the heuristic, unchanged
+      (cold start is bit-identical to :func:`select_for_group`);
+    * measurement ranks a route best among the LEGAL candidates -> that
+      route; a measured-or-agreeing ``matmul`` is promoted to
+      :data:`STRATEGY_MATMUL_BINDING` (binding inside the kernel guards);
+    * the deterministic epsilon slot explores an unmeasured legal candidate
+      as an ADVISORY hint — exploration never emits the binding form.
+    """
+    from bqueryd_tpu.plan import calibrate
+
+    strategy, est, total_rows = select_for_group(
+        stats_by_file, filenames, groupby_cols
+    )
+    if (
+        calibration is None
+        or not calibrate.enabled()
+        or est is None
+        or total_rows is None
+        or strategy not in (STRATEGY_MATMUL, STRATEGY_SCATTER, STRATEGY_SORT)
+    ):
+        return strategy, est, total_rows, "cold"
+    choice, reason = calibration.choose(
+        total_rows, est, None, candidate_strategies(total_rows, est),
+        strategy,
+    )
+    if choice == STRATEGY_MATMUL and reason in ("measured", "agree"):
+        # measurement backs the MXU route (reason "prior" — an analytic
+        # extrapolation with zero matmul walls — stays advisory): binding
+        # inside the guards (only the op/dtype profitability heuristic
+        # yields; backend + value guards still stand at the kernel)
+        choice = STRATEGY_MATMUL_BINDING
+    return choice, est, total_rows, reason

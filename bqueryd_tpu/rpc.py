@@ -66,6 +66,11 @@ class RPC:
         #: trace id of the most recent call — feed it to ``rpc.trace(...)``
         #: to pull the controller's per-phase waterfall for that query
         self.last_trace_id = None
+        #: per-shard-group phase timings / strategy report of the most
+        #: recent groupby reply ({"hints": ..., "effective": ...} for the
+        #: latter — what the planner asked for vs what actually compiled)
+        self.last_call_timings = None
+        self.last_call_strategies = None
         self.identity = os.urandom(8).hex()
         self.store = coordination_store(
             coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
@@ -205,6 +210,7 @@ class RPC:
             raise RPCError(envelope.get("error"))
         payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
         self.last_call_timings = envelope.get("timings")
+        self.last_call_strategies = envelope.get("strategies")
         if self.legacy_merge:
             return self._legacy_merge_frames(payloads)
         merged = hostmerge.merge_payloads(payloads)

@@ -457,6 +457,18 @@ class WorkerBase:
         except Exception:
             self.logger.exception("SIGUSR1 debug dump failed")
 
+    def _calibration_to_advertise(self):
+        """The WRM calibration summary, or None (non-calc role, disabled,
+        or cold) — a calibration failure must never break liveness."""
+        if getattr(self, "workertype", None) != "calc":
+            return None
+        try:
+            from bqueryd_tpu.plan import calibrate
+
+            return calibrate.summary_for_wire()
+        except Exception:
+            return None
+
     def prepare_wrm(self):
         # getattr defence: embedders and tests build workers piecemeal
         # (__new__), and a missing registry must never break the WRM
@@ -494,6 +506,12 @@ class WorkerBase:
                 # strategy selection; None for non-calc roles and for beats
                 # where the unchanged stats were advertised recently
                 "shard_stats": self._stats_to_advertise(),
+                # measured-cost calibration summary (plan.calibrate): the
+                # worker's per-(rows, groups, dtype, backend, strategy)
+                # kernel-wall cells, absorbed controller-side into the
+                # model select_calibrated consults; None when calibration
+                # is disabled or nothing has been measured yet
+                "calibration": self._calibration_to_advertise(),
                 # latency histogram snapshot (fixed buckets, JSON-safe):
                 # controllers aggregate these fleet-wide by bucket-vector
                 # addition (get_info "worker_histograms" + peer gossip)
@@ -1009,6 +1027,10 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu.parallel import hostmerge
         from bqueryd_tpu.parallel.executor import MeshQueryExecutor
 
+        # what the kernel actually ran post-guards, for the reply envelope /
+        # kernel span (satellite: hints used to normalize silently and
+        # nothing could tell what executed)
+        self._last_effective_strategy = None
         total_rows = sum(int(t.nrows) for t in tables)
         # the same per-query cost estimate execute_local uses, worst shard
         # wins — a mismatched (optimistic) rate here would let slow-rated
@@ -1037,9 +1059,13 @@ class WorkerNode(WorkerBase):
             import jax
 
             try:
-                return self.mesh_executor.execute(
+                result = self.mesh_executor.execute(
                     tables, query, strategy=strategy
                 )
+                self._last_effective_strategy = (
+                    self.mesh_executor.last_effective_strategy
+                )
+                return result
             except ops_mod.CompositeOverflow:
                 # the mesh alignment needs radix-packed composites; a key
                 # space past int64 degrades to the per-shard engine path,
@@ -1062,9 +1088,13 @@ class WorkerNode(WorkerBase):
                 )
         if len(tables) == 1:
             self.engine.timer = timer
-            return self.engine.execute_local(
+            result = self.engine.execute_local(
                 tables[0], query, strategy=strategy
             )
+            self._last_effective_strategy = (
+                self.engine.last_effective_strategy
+            )
+            return result
         self.engine.timer = timer
         # pipelined per-shard fallback: shards run on the bounded pipeline
         # pool (BQUERYD_TPU_PIPELINE_THREADS; 1 restores the serial loop),
@@ -1078,6 +1108,9 @@ class WorkerNode(WorkerBase):
             lambda t: self.engine.execute_local(t, query, strategy=strategy),
             tables,
         )
+        # shards share one query shape, so the engine's last route speaks
+        # for the group (a host/device split across shards reports the last)
+        self._last_effective_strategy = self.engine.last_effective_strategy
         with timer.phase("hostmerge"):
             merged = hostmerge.merge_payloads(payloads)
         from bqueryd_tpu.models.query import ResultPayload
@@ -1137,12 +1170,21 @@ class WorkerNode(WorkerBase):
         )
         strategy = None
         if fragment:
-            from bqueryd_tpu.plan import fragment_to_query
+            from bqueryd_tpu.plan import calibrate, fragment_to_query
 
             query = fragment_to_query(fragment)
             strategy = fragment.get("strategy")
             if strategy in (None, "auto"):
                 strategy = None
+            elif strategy == "matmul" and fragment.get("strategy_binding"):
+                # calibration-backed promotion rides the wire as advisory
+                # "matmul" + this flag (old workers ignore it — see
+                # plan.logical.fragment_for); reconstruct the binding form
+                # unless BQUERYD_TPU_CALIB=0, the kill switch that restores
+                # pre-calibration behaviour exactly on this worker even
+                # when a calibrating controller emitted the promotion
+                if calibrate.enabled():
+                    strategy = "matmul!"
         else:
             query = GroupByQuery(
                 groupby_cols,
@@ -1173,6 +1215,9 @@ class WorkerNode(WorkerBase):
             if data is not None:
                 timer.timings["result_cache"] = 0.0
         mem_tags = None
+        # a result-cache hit compiled nothing: "cached" keeps the reply's
+        # route report honest instead of silently dropping the key
+        effective = "cached" if data is not None else None
         if data is None:
             import contextlib
 
@@ -1191,6 +1236,16 @@ class WorkerNode(WorkerBase):
                 payload = self._execute(
                     tables, query, timer, strategy=strategy
                 )
+            effective = getattr(self, "_last_effective_strategy", None)
+            if recorder is not None and effective:
+                # the kernel span carries what the executor actually
+                # compiled post-guards — rpc.trace() waterfalls can now
+                # tell a promoted matmul from a silently-normalized hint
+                for span in recorder.spans:
+                    if span.get("name") == "kernel":
+                        span.setdefault("tags", {})[
+                            "effective_strategy"
+                        ] = effective
             # the execute above is proof the backend answered: safe to
             # (lazily) enumerate devices for HBM sampling from now on
             obs_profile.profiler().note_devices()
@@ -1251,6 +1306,12 @@ class WorkerNode(WorkerBase):
             reply["deadline_remaining"] = round(remaining, 4)
         if strategy is not None:
             reply["strategy"] = strategy
+        if effective is not None:
+            # post-guard reality, distinct from the hint: declared in
+            # messages.RESULT_ENVELOPE_SCHEMA/ENVELOPE_SCHEMA, folded by the
+            # controller into the client result envelope and bench's
+            # chosen_strategy
+            reply["effective_strategy"] = effective
         self.logger.debug("calc %s done: %s", filename, timer.as_dict())
         return reply
 
