@@ -1318,6 +1318,196 @@ def main():
                     flush=True,
                 )
 
+        # merge: the device-resident distributed merge story — (1) D2H bytes
+        # of the span-owned collective merge (devicemerge counters) vs the
+        # BQUERYD_TPU_DEVICE_MERGE=0 host-gather baseline's payload bytes
+        # over ZeroMQ (the controller's reply_payload_bytes counter — proved
+        # from metrics, not instrumentation); (2) THE GATE: device-merge
+        # final-table D2H bytes <= 10% of the host-merge payload bytes on
+        # the sharded config; (3) parity probes across the fuzz-shaped
+        # query mix (int sum, multi-agg incl. float mean, count_distinct):
+        # =1 vs =0 must agree bit-identically on integer aggregates and to
+        # reassociation ulps on float ones.
+        merge_detail = {}
+        if (
+            os.environ.get("BENCH_MERGE", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            from bqueryd_tpu.parallel import devicemerge as dm_mod
+
+            controller_node = nodes[0]
+            files, gcols, aggs, where = config_query(HEADLINE, names)
+            # Pin the switch explicitly for each leg (a pre-set =0 in the
+            # operator's environment must not turn the device leg into a
+            # second host leg that trivially passes the gate), and restore
+            # whatever the operator had afterwards.
+            prior_dm = os.environ.get("BQUERYD_TPU_DEVICE_MERGE")
+            try:
+                # (1a) device-mode bytes: counter delta across one query on
+                # the device-merge route
+                os.environ["BQUERYD_TPU_DEVICE_MERGE"] = "1"
+                rpc.groupby(files, gcols, aggs, where)  # warm
+                before = dm_mod.stats().snapshot()
+                headline_dev = rpc.groupby(files, gcols, aggs, where)
+                after = dm_mod.stats().snapshot()
+                device_fetched = (
+                    after["bytes_fetched"]["device"]
+                    - before["bytes_fetched"]["device"]
+                )
+                d2h_saved = (
+                    after["d2h_bytes_saved"] - before["d2h_bytes_saved"]
+                )
+                device_modes = dict(rpc.last_call_merge_modes or {})
+
+                # (1b) host-gather baseline: kill switch off => per-shard
+                # dispatch, partial payloads over zmq, client-side hostmerge;
+                # payload bytes from the controller counter
+                os.environ["BQUERYD_TPU_DEVICE_MERGE"] = "0"
+                rpc.groupby(files, gcols, aggs, where)  # warm the route
+                c0 = controller_node.counters["reply_payload_bytes"]
+                t0 = time.perf_counter()
+                headline_host = rpc.groupby(files, gcols, aggs, where)
+                host_wall = time.perf_counter() - t0
+                host_payload_bytes = (
+                    controller_node.counters["reply_payload_bytes"] - c0
+                )
+                host_modes = dict(rpc.last_call_merge_modes or {})
+                os.environ["BQUERYD_TPU_DEVICE_MERGE"] = "1"
+                t0 = time.perf_counter()
+                rpc.groupby(files, gcols, aggs, where)
+                device_wall = time.perf_counter() - t0
+
+                # (3) parity probes: =1 vs =0 across the query mix.  The
+                # count_distinct probe is ROUTE COVERAGE, not a mesh-merge
+                # parity check: count_distinct is not in MERGEABLE_OPS, so
+                # both legs take the per-shard host route — it proves the
+                # kill switch leaves non-mergeable queries undisturbed.
+                probes = {
+                    "sharded_sum": (files, gcols, aggs, where),
+                    "multikey_multiagg": config_query("multikey", names),
+                    "count_distinct": (
+                        files,
+                        ["passenger_count"],
+                        [["payment_type", "count_distinct", "nd"]],
+                        [],
+                    ),
+                }
+                parity = {}
+                for pname, (pf, pg, pa, pw) in probes.items():
+                    if pname == "sharded_sum":
+                        # the byte-measurement legs above already ran this
+                        # exact query on both routes — reuse their results
+                        r_dev, r_host = headline_dev, headline_host
+                    else:
+                        os.environ["BQUERYD_TPU_DEVICE_MERGE"] = "1"
+                        r_dev = rpc.groupby(pf, pg, pa, pw)
+                        os.environ["BQUERYD_TPU_DEVICE_MERGE"] = "0"
+                        r_host = rpc.groupby(pf, pg, pa, pw)
+                    r_dev = r_dev.sort_values(pg).reset_index(drop=True)
+                    r_host = r_host.sort_values(pg).reset_index(drop=True)
+                    identical = len(r_dev) == len(r_host)
+                    max_rel = 0.0
+                    # a row-count mismatch is already a parity failure; the
+                    # per-column compare must not run on mismatched shapes
+                    # (np.allclose would raise, and the generic except would
+                    # swallow THE GATE instead of failing it)
+                    for col in (r_dev.columns if identical else ()):
+                        a = r_dev[col].to_numpy()
+                        b = r_host[col].to_numpy()
+                        if a.dtype.kind in "iub":
+                            identical = identical and bool(
+                                np.array_equal(a, b)
+                            )
+                        else:
+                            af = a.astype(np.float64)
+                            bf = b.astype(np.float64)
+                            identical = identical and bool(
+                                np.allclose(af, bf, rtol=1e-9,
+                                            equal_nan=True)
+                            )
+                            with np.errstate(all="ignore"):
+                                rel = np.nanmax(
+                                    np.abs(af - bf)
+                                    / np.maximum(np.abs(bf), 1e-30)
+                                ) if len(af) else 0.0
+                            max_rel = max(max_rel, float(rel))
+                    parity[pname] = {
+                        "rows": int(len(r_dev)),
+                        "identical": bool(identical),
+                        "float_max_rel_err": max_rel,
+                    }
+
+                ratio = (
+                    device_fetched / host_payload_bytes
+                    if host_payload_bytes else None
+                )
+                merge_detail = {
+                    "device_bytes_fetched": int(device_fetched),
+                    "d2h_bytes_saved": int(d2h_saved),
+                    "host_payload_bytes": int(host_payload_bytes),
+                    "d2h_ratio": (
+                        None if ratio is None else round(ratio, 4)
+                    ),
+                    "within_10pct": (
+                        None if ratio is None else bool(ratio <= 0.10)
+                    ),
+                    "device_wall_s": round(device_wall, 4),
+                    "host_gather_wall_s": round(host_wall, 4),
+                    "device_merge_modes": device_modes,
+                    "host_merge_modes": host_modes,
+                    "parity": parity,
+                    "note": (
+                        "device = span-owned reduce-scatter merge, final "
+                        "table only fetched; host = DEVICE_MERGE=0 "
+                        "host-gather (per-shard payloads over zmq, "
+                        "hostmerge client-side).  Gate: device D2H <= 10% "
+                        "of host payload bytes; integer aggregates "
+                        "bit-identical across modes, floats to "
+                        "reassociation ulps"
+                    ),
+                }
+                print(
+                    f"[bench] merge: device D2H {device_fetched} B vs "
+                    f"host-gather payloads {host_payload_bytes} B "
+                    f"(ratio {merge_detail['d2h_ratio']}, saved "
+                    f"{d2h_saved} B), parity "
+                    f"{ {k: v['identical'] for k, v in parity.items()} }",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                # THE GATE (BENCH_MERGE_GATE=0 records without asserting)
+                if os.environ.get("BENCH_MERGE_GATE", "1") == "1":
+                    # zero device bytes means the headline query never rode
+                    # the mesh-merge path at all — a 0-byte "pass" measures
+                    # nothing (same sanity assert as the CI smoke)
+                    assert device_fetched > 0, (
+                        "device-merge leg recorded no merge bytes: the "
+                        "headline query did not take the device-merge path"
+                    )
+                    assert merge_detail["within_10pct"], (
+                        f"device-merge D2H bytes {device_fetched} exceed "
+                        f"10% of host-merge payload bytes "
+                        f"{host_payload_bytes}"
+                    )
+                    for pname, entry in parity.items():
+                        assert entry["identical"], (
+                            f"merge parity failed on {pname}: {entry}"
+                        )
+            except AssertionError:
+                raise  # the merge gate is deterministic: fail the bench
+            except Exception as exc:
+                print(
+                    f"[bench] merge section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            finally:
+                if prior_dm is None:
+                    os.environ.pop("BQUERYD_TPU_DEVICE_MERGE", None)
+                else:
+                    os.environ["BQUERYD_TPU_DEVICE_MERGE"] = prior_dm
+
         # -- static-analysis guard: suite runtime + per-family finding
         # counts (proves the full pass stays interactive — a few seconds —
         # and that the tree the bench measured was lint-clean)
@@ -1406,6 +1596,10 @@ def main():
             # ratio, working-set / storage / result cache hit rates, and
             # the zero-factorize codes-cache probe
             "pipeline": pipeline_detail,
+            # device-resident merge: span-merge D2H bytes vs the
+            # DEVICE_MERGE=0 host-gather payload bytes, the <=10% gate,
+            # and the =1 vs =0 parity probes
+            "merge": merge_detail,
             # suite runtime + per-family finding counts (the bench guard
             # proving the full static pass stays under a few seconds)
             "static_analysis": static_analysis_detail,
@@ -1468,6 +1662,7 @@ def main():
                         "pipeline_overlap_ratio": pipeline_detail.get(
                             "overlap_ratio"
                         ),
+                        "merge_d2h_ratio": merge_detail.get("d2h_ratio"),
                         "jit_cache_hit_rate": profiling_detail.get(
                             "jit_cache_hit_rate"
                         ),

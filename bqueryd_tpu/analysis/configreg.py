@@ -120,7 +120,12 @@ ENV_REGISTRY = {
         _v("HOST_KERNEL_ROWS", "int", "auto",
            "host-route queries below this many rows (0 = always device)"),
         _v("PACKED_FETCH", "flag", "1",
-           "fetch merged results as one packed buffer"),
+           "fetch merged results as one packed buffer",
+           related=("DEVICE_MERGE",)),
+        _v("DEVICE_MERGE", "flag", "1",
+           "device-resident distributed merge over the mesh (0 = host-side "
+           "hostmerge fallback + per-shard dispatch)",
+           related=("PACKED_FETCH",)),
         _v("RESULT_CACHE_BYTES", "int", "256 MiB",
            "worker result cache (0=off)"),
         _v("PIPELINE_THREADS", "int", "min(16, cpu)",

@@ -85,6 +85,9 @@ COUNTER_SPECS = {
     "slow_queries": "finished queries past BQUERYD_TPU_SLOW_QUERY_MS",
     "health_avoided_dispatches":
         "dispatch decisions that routed around a degraded/wedged worker",
+    "reply_payload_bytes":
+        "cumulative result-payload bytes received in worker calc replies "
+        "(the controller-side twin of the worker's reply_bytes histogram)",
 }
 
 
@@ -1031,17 +1034,25 @@ class ControllerNode:
         # already-merged payload (the worker's on-device psum merge);
         # completion is counted in covered filenames, not replies
         key = tuple(filename) if isinstance(filename, list) else (filename,)
+        data = msg.get("data") or b""
+        # payload bytes over the wire, counted once per reply (not per
+        # subscriber): the metric the bench's merge section reads as the
+        # host-gather baseline the device-resident merge is judged against
+        self.counters["reply_payload_bytes"] += len(data)
         delivered = False
         for p in parents:
             segment = self.rpc_segments.get(p)
             if segment is None:
                 continue  # that subscriber aborted earlier
             delivered = True
-            segment["results"][key] = msg.get("data") or b""
+            segment["results"][key] = data
             segment["timings"][key] = msg.get("phase_timings")
             effective = msg.get("effective_strategy")
             if isinstance(effective, str):
                 segment.setdefault("effective", {})[key] = effective
+            merge_mode = msg.get("merge_mode")
+            if isinstance(merge_mode, str):
+                segment.setdefault("merge", {})[key] = merge_mode
             # worker-side spans (calc root + phases) fold into the timeline;
             # shared dispatches land on every subscriber's segment
             spans = msg.get("spans")
@@ -1094,6 +1105,10 @@ class ControllerNode:
                         segment.get("effective")
                     ),
                 },
+                # per shard-group: how the worker merged the payload
+                # (device = ICI-mesh collective, host = hostmerge fallback,
+                # none = single payload)
+                "merge_modes": self._compact_timings(segment.get("merge")),
             },
             protocol=4,
         )
@@ -1880,6 +1895,7 @@ class ControllerNode:
             "plan_sig": str(plan.signature()),
             "strategies": {},         # hint -> dispatch count
             "effective": {},          # shard-group key -> executed route
+            "merge": {},              # shard-group key -> merge_mode
         }
         self.rpc_segments[parent_token] = segment
         if not keep:
@@ -2011,10 +2027,17 @@ class ControllerNode:
         probe = GroupByQuery(
             groupby_cols, agg_list, aggregate=kwargs.get("aggregate", True)
         )
+        from bqueryd_tpu.parallel import devicemerge
+
         batchable = (
             kwargs.get("batch", True)
             and probe.aggregate
             and all(op in MERGEABLE_OPS for op in probe.ops)
+            # BQUERYD_TPU_DEVICE_MERGE=0: the merge stays host-side end to
+            # end — per-shard dispatch so every shard's partial table rides
+            # the wire and merges via hostmerge (the measurable host-gather
+            # baseline the device-resident merge is judged against)
+            and devicemerge.device_merge_enabled()
         )
         if not batchable:
             return [[f] for f in filenames]

@@ -99,6 +99,10 @@ ENVELOPE_SCHEMA = {
                           "(matmul/scatter/sort/host; 'cached' = result-"
                           "cache hit, nothing compiled) — hints may "
                           "normalize",
+    "merge_mode": "how the reply's partials merged: 'device' (ICI-mesh "
+                  "collective, final table only fetched), 'host' "
+                  "(hostmerge.merge_payloads fallback), 'none' (single "
+                  "payload, nothing merged)",
     "error": "failure detail on error/ticketdone paths",
     "result": "base64-pickled rpc verb return value",
     # worker register messages (WRM heartbeats)
@@ -140,6 +144,8 @@ RESULT_ENVELOPE_SCHEMA = {
     "timings": "compacted per-phase timing summary",
     "strategies": "planner report: {hints: hint->dispatches, effective: "
                   "shard-group->executed kernel route}",
+    "merge_modes": "shard-group -> merge_mode the worker reported "
+                   "(device/host/none; see the merge_mode envelope key)",
     "error": "failure reason when ok is False",
 }
 

@@ -42,6 +42,13 @@ LATENCY_BUCKETS_S = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 60.0,
 )
 
+#: Fixed log-scale payload-size buckets (bytes), 4x steps from 256 B to
+#: 1 GiB.  Same contract as LATENCY_BUCKETS_S: a module constant, never
+#: instance-configurable, so every node holds the identical vector and
+#: cross-worker merging stays bucket-vector addition (lint-enforced — a
+#: size histogram with latency buckets would be as wrong as the reverse).
+BYTES_BUCKETS = tuple(float(256 << (2 * i)) for i in range(12))
+
 
 def _fmt_value(v):
     if v == math.inf:
@@ -325,8 +332,9 @@ class MetricsRegistry:
     def lint(self):
         """Registry self-check (invoked from tests): every metric name
         matches METRIC_NAME_RE (counters may suffix ``_total``), has
-        non-empty help text, and every histogram carries the identical
-        LATENCY_BUCKETS_S vector (the cross-node merge precondition).
+        non-empty help text, and every histogram carries one of the shared
+        module bucket vectors — LATENCY_BUCKETS_S for latencies,
+        BYTES_BUCKETS for sizes (the cross-node merge precondition).
         Returns a list of violation strings — empty means clean."""
         problems = []
         for metric in self.metrics():
@@ -337,12 +345,14 @@ class MetricsRegistry:
                 problems.append(f"{metric.name}: name fails {METRIC_NAME_RE.pattern}")
             if not (metric.help or "").strip():
                 problems.append(f"{metric.name}: missing help text")
-            if metric.kind == "histogram" and metric.buckets != tuple(
-                LATENCY_BUCKETS_S
+            if metric.kind == "histogram" and metric.buckets not in (
+                tuple(LATENCY_BUCKETS_S), tuple(BYTES_BUCKETS)
             ):
                 problems.append(
-                    f"{metric.name}: bucket vector differs from "
-                    "LATENCY_BUCKETS_S (cross-node merge precondition)"
+                    f"{metric.name}: bucket vector is neither "
+                    "LATENCY_BUCKETS_S nor BYTES_BUCKETS (cross-node "
+                    "merge precondition: buckets must be a shared module "
+                    "constant)"
                 )
             for label in metric.labels:
                 if not re.match(r"^[a-z][a-z0-9_]*$", label):

@@ -122,6 +122,7 @@ from bqueryd_tpu.ops.factorize import (  # noqa: E402
 from bqueryd_tpu.ops.groupby import (  # noqa: E402
     AGG_OPS,
     MERGEABLE_OPS,
+    bucketize_partials,
     combine_partials,
     expand_mask_by_group,
     finalize,
@@ -132,6 +133,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     host_sorted_count_distinct,
     kernel_route,
     partial_tables,
+    partial_tables_bucketized,
     program_bucket,
     psum_partials,
 )
@@ -161,7 +163,9 @@ __all__ = [
     "host_sorted_count_distinct",
     "kernel_route",
     "partial_tables",
+    "partial_tables_bucketized",
     "program_bucket",
+    "bucketize_partials",
     "combine_partials",
     "psum_partials",
     "finalize",

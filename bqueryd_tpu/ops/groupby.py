@@ -443,6 +443,45 @@ def partial_tables(codes, measures, ops, n_groups, mask=None,
     )
 
 
+def bucketize_partials(partials, n_groups, n_buckets):
+    """Re-emit a partial-table pytree on the key-span bucket layout: every
+    leaf's group axis is padded from ``n_groups`` to ``span * n_buckets``
+    (``span = ceil(n_groups / n_buckets)``) so bucket ``d`` — device ``d``
+    of the merge mesh — owns the contiguous span ``[d*span, (d+1)*span)``.
+
+    Returns ``(padded_partials, span)``.  Pad entries are zeros; they are
+    appended PAST every real group, so sums/counts gain nothing and min/max
+    pads can never shadow a real group — the collector slices the pad tail
+    off after the fetch.  Trace-safe (``jnp.pad`` only), and called on the
+    OUTPUT of :func:`partial_tables`, so every kernel guard (matmul
+    backend/ceiling, scatter budgets, strategy hints) applies unchanged to
+    the bucketized emission."""
+    from bqueryd_tpu.parallel.devicemerge import bucket_span
+
+    span, padded = bucket_span(n_groups, n_buckets)
+    pad = padded - int(n_groups)
+    if pad == 0:
+        return partials, span
+    out = jax.tree_util.tree_map(
+        lambda leaf: jnp.pad(leaf, (0, pad)), partials
+    )
+    return out, span
+
+
+def partial_tables_bucketized(codes, measures, ops, n_groups, n_buckets,
+                              mask=None, null_sentinels=None, strategy=None):
+    """:func:`partial_tables` with the output re-laid onto the
+    ``n_buckets``-way key-span bucket layout (see
+    :func:`bucketize_partials`) — the emission form the device-resident
+    distributed merge consumes.  Same guards, same strategies, same
+    partial semantics; only the group-axis padding differs."""
+    partials = partial_tables(
+        codes, measures, ops, n_groups, mask=mask,
+        null_sentinels=null_sentinels, strategy=strategy,
+    )
+    return bucketize_partials(partials, n_groups, n_buckets)
+
+
 def kernel_route(strategy, measures, ops, n, n_groups):
     """Predict the physical route :func:`partial_tables` takes for this
     dispatch WITHOUT running it — the ``effective_strategy`` reported in

@@ -195,6 +195,10 @@ class MeshQueryExecutor:
         #: (post-guards) — the worker surfaces it as ``effective_strategy``
         #: in calc replies and the ``kernel`` trace span
         self.last_effective_strategy = None
+        #: how the last execute() merged partials across the mesh
+        #: ("device" | "host") — the worker surfaces it as the reply
+        #: envelope's ``merge_mode`` key
+        self.last_merge_mode = None
         from bqueryd_tpu.ops.workingset import WorkingSet
 
         # the device-resident working-set layer (ops/workingset.py): LRU
@@ -487,6 +491,7 @@ class MeshQueryExecutor:
         from bqueryd_tpu import ops
 
         self.last_effective_strategy = None  # set at the kernel dispatch
+        self.last_merge_mode = None          # set once the mode resolves
         if strategy in (None, "auto", "host"):
             # "host" is meaningless inside a mesh program; the worker should
             # not have routed such a query here, but degrade to auto rather
@@ -535,6 +540,16 @@ class MeshQueryExecutor:
         cols_key = tuple(query.groupby_cols)
         mesh = self.mesh
         n_dev = mesh.devices.size
+        from bqueryd_tpu.parallel import devicemerge
+
+        # the traced cross-device merge for this query: span-owned
+        # reduce-scatter by default, the hostmerge fallback under the
+        # BQUERYD_TPU_DEVICE_MERGE=0 kill switch, replicated psum on
+        # multi-host pods (devicemerge.resolve_mode)
+        merge_mode = devicemerge.resolve_mode()
+        self.last_merge_mode = (
+            "host" if merge_mode == devicemerge.MODE_HOST else "device"
+        )
         sharding = NamedSharding(mesh, P(self.axis_name, None))
         codes_key = (
             tables_key, "codes", cols_key, _where_signature(query), n_dev,
@@ -566,20 +581,33 @@ class MeshQueryExecutor:
             self.workingset.evict_under_pressure()
 
         # chunk-decode prefetch (pipeline stage 1): fire storage decode of
-        # the cache-missing measure columns on the pipeline pool NOW, so
-        # decode overlaps the mask/fold + codes-H2D work below.  Skipped
-        # when the ALIGNMENT is cold: align's own per-shard fan-out needs
-        # the pool, and a FIFO pool would drain these decode jobs first,
-        # serializing decode ahead of align instead of overlapping either.
+        # the cache-missing measure columns on the pipeline pool so decode
+        # overlaps the mask/fold + codes-H2D work below.  Deferred until
+        # AFTER alignment when the alignment is cold: align's own per-shard
+        # fan-out needs the pool, and a FIFO pool would drain these decode
+        # jobs first, serializing decode ahead of align instead of
+        # overlapping either.  (Firing nowhere on the cold path was the
+        # 0.115 cold storage-decode hit rate: the depth-2 column build paid
+        # every decode inline with nothing warmed.)  The prefetch decodes
+        # through ``ctable.column_raw`` on the SAME table instances the
+        # build loop probes, so the warmed entries land under the content
+        # keys the build path reads.
         prefetch = {}
-        if align_warm and pipeline.pipeline_threads() > 1:
+
+        def _prefetch_missing():
+            if pipeline.pipeline_threads() <= 1:
+                return
             for col in missing_cols:
                 futs = []
                 for t in tables:
                     warm = getattr(t, "prefetch", None)
                     if warm is not None:
                         futs.extend(warm([col]))
-                prefetch[col] = futs
+                if futs:
+                    prefetch[col] = futs
+
+        if align_warm:
+            _prefetch_missing()
 
         with self._phase("align"), pipeline.stage("align"):
             cached = self._align_cache.get((tables_key, cols_key))
@@ -597,6 +625,13 @@ class MeshQueryExecutor:
             else:
                 dense, combos, cards, key_values = cached
             n_groups = max(len(combos), 1)
+
+        if not align_warm:
+            # cold-align queries fire the measure prefetch HERE, once the
+            # align fan-out has released the pool: decode overlaps the
+            # mask/fold/pack + codes-H2D work below instead of serializing
+            # inside the column build
+            _prefetch_missing()
 
         codes_d = self._codes_cache.get(codes_key)
         if codes_d is None:
@@ -735,6 +770,7 @@ class MeshQueryExecutor:
                         null_sentinels=sentinels,
                         strategy=strategy,
                         measure_index=measure_index,
+                        merge_mode=merge_mode,
                     )
                     kernel_wall = time.perf_counter() - kernel_clock
                     break
@@ -767,12 +803,16 @@ class MeshQueryExecutor:
             if n_prog != n_groups:
                 import jax as _jax
 
+                # group axis is LAST: host-mode partials carry a leading
+                # per-device axis, merged tables are flat
                 merged = _jax.tree_util.tree_map(
-                    lambda a: a[:n_groups], merged
+                    lambda a: a[..., :n_groups], merged
                 )
 
-        with self._phase("collect"), pipeline.stage("merge"):
-            rows = merged["rows"]
+        def collect_payload(partial_table):
+            """One merged (or single-device) partial table -> ResultPayload
+            keyed by actual key values."""
+            rows = partial_table["rows"]
             present = rows > 0
             combos_present = combos[present]
             if len(query.groupby_cols) == 1:
@@ -784,7 +824,7 @@ class MeshQueryExecutor:
                 idx = np.asarray(codes_g, dtype=np.int64)
                 keys[col] = key_values[col][idx]
             aggs = []
-            for in_col, part in zip(query.in_cols, merged["aggs"]):
+            for in_col, part in zip(query.in_cols, partial_table["aggs"]):
                 stored = _stored_dtype(tables, in_col)
                 selected = {}
                 for k, v in part.items():
@@ -809,6 +849,23 @@ class MeshQueryExecutor:
                 out_cols=query.out_cols,
                 value_kinds=list(measure_kinds),
             )
+
+        with self._phase("collect"), pipeline.stage("merge"):
+            if merge_mode == devicemerge.MODE_HOST:
+                # kill-switch fallback: every device's partial table left
+                # HBM whole; key them by actual key values and merge on the
+                # worker host with the always-correct value-keyed merge —
+                # bit-identical aggregates, host-gather economics
+                from bqueryd_tpu.parallel import hostmerge
+
+                payloads = [
+                    collect_payload(
+                        jax.tree_util.tree_map(lambda a: a[d], merged)
+                    )
+                    for d in range(int(n_dev))
+                ]
+                return ResultPayload(hostmerge.merge_payloads(payloads))
+            return collect_payload(merged)
 
 
 def _pack_leaf(leaf):
@@ -890,7 +947,7 @@ def _shard_map(fn, mesh, in_specs, out_specs, check):
 @functools.lru_cache(maxsize=64)
 def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
                   null_sentinels=None, route=None, strategy=None,
-                  measure_index=None):
+                  measure_index=None, merge_mode="psum"):
     """Build + cache the jitted shard_map program for one query shape.
 
     The key carries everything that can change the traced program — measure
@@ -901,12 +958,26 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
     cache entry must map to exactly one trace.  ``measure_index`` (static)
     maps each aggregation to its slot in the DEDUPLICATED measure blocks:
     ``sum+count+mean`` of one column ride one uploaded block and one
-    program argument instead of three."""
+    program argument instead of three.
+
+    ``merge_mode`` (static, devicemerge.MODE_*) picks the cross-device
+    merge traced into the program:
+
+    * ``device`` — bucketized partials reduce-scatter over the mesh axis so
+      each device owns a contiguous key span; outputs are span-sized and
+      the D2H fetch is the final table only (the default);
+    * ``psum``   — the all-reduce + replicated-output contract (multi-host
+      pods, where a span-sharded output is not host-fetchable);
+    * ``host``   — NO collective: every device's full partial table comes
+      back (leading device axis host-side) for ``hostmerge.merge_payloads``
+      — the kill-switch baseline."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from bqueryd_tpu import ops
+    from bqueryd_tpu.parallel import devicemerge
 
+    n_dev = int(mesh.devices.size)
     spec = {}  # populated at trace time: treedef + (dtype, shape) per leaf
 
     def block_fn(codes_blk, *measure_blks):
@@ -924,7 +995,22 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
             null_sentinels=null_sentinels,
             strategy=strategy,
         )
-        merged = ops.psum_partials(partials, axis)
+        if merge_mode == devicemerge.MODE_DEVICE:
+            # key-span ownership: pad onto the bucket layout (behind the
+            # kernel guards — this is the dispatched partials' OUTPUT) and
+            # reduce-scatter so this device keeps only its span's totals
+            bucketized, span = ops.bucketize_partials(
+                partials, n_groups, n_dev
+            )
+            merged = devicemerge.scatter_merge_partials(
+                bucketized, axis, n_dev, span
+            )
+        elif merge_mode == devicemerge.MODE_HOST:
+            # kill switch: no collective — the per-device partial tables
+            # leave HBM whole and merge on the worker host
+            merged = partials
+        else:
+            merged = ops.psum_partials(partials, axis)
         if not pack:
             return merged
         leaves, treedef = jax.tree_util.tree_flatten(merged)
@@ -938,12 +1024,15 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
 
     # pallas_call outputs carry no varying-mesh-axes metadata, so the vma/rep
     # check would reject the kernel path; the psum in block_fn is what makes
-    # the out_specs=P() replication true by construction
+    # the out_specs=P() replication true by construction.  Span-owned
+    # (device) and per-device (host) outputs are axis-sharded instead: the
+    # global result concatenates every device's slice in device order.
+    out_spec = P() if merge_mode == devicemerge.MODE_PSUM else P(axis)
     fn = _shard_map(
         block_fn,
         mesh=mesh,
         in_specs=tuple([P(axis, None)] * len(in_dtypes)),
-        out_specs=P(),
+        out_specs=out_spec,
         check=False,
     )
     # compile/call accounting (obs.profile): every mesh-program call lands
@@ -1037,16 +1126,69 @@ def _collective_guard():
     return contextlib.nullcontext()
 
 
+def _assemble_sharded(flat, spec, n_dev, merge_mode):
+    """Host-side reassembly of a packed axis-sharded fetch: the global byte
+    buffer concatenates every device's packed slice in device order.  Device
+    mode concatenates the span slices back into the (padded) merged table;
+    host mode stacks the full per-device tables onto a leading device axis.
+    Layout normalization (pad-tail slice / device-axis reshape) is the
+    caller's ``finish`` — the contract lives there for BOTH fetch paths."""
+    import jax
+
+    per_dev = [
+        _unpack_host(chunk, spec["leaves"])
+        for chunk in flat.reshape(n_dev, -1)
+    ]
+    from bqueryd_tpu.parallel import devicemerge
+
+    if merge_mode == devicemerge.MODE_DEVICE:
+        leaves = [
+            np.concatenate([dev[i] for dev in per_dev])
+            for i in range(len(spec["leaves"]))
+        ]
+    else:
+        leaves = [
+            np.stack([dev[i] for dev in per_dev])
+            for i in range(len(spec["leaves"]))
+        ]
+    return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
+
+
+def _record_merge_bytes(merge_mode, fetched, n_dev, n_groups, merged):
+    """Account the D2H movement of one merged fetch: ``fetched`` actual
+    bytes vs the host-gather counterfactual — every device's full partial
+    table (``n_dev x n_groups`` rows per leaf) crossing to the host."""
+    from bqueryd_tpu.parallel import devicemerge
+
+    leaves = []
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(merged):
+        leaves.append(np.dtype(np.asarray(leaf).dtype).itemsize)
+    counterfactual = n_dev * n_groups * sum(leaves)
+    devicemerge.stats().record(
+        merge_mode, fetched, saved=counterfactual - int(fetched)
+    )
+
+
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
-                   null_sentinels=None, strategy=None, measure_index=None):
+                   null_sentinels=None, strategy=None, measure_index=None,
+                   merge_mode="psum"):
     """Run the mesh program and return the merged partials pytree ON HOST
     (numpy leaves) — fetching one packed buffer when packing is enabled.
     ``measures_d`` holds one device block per DISTINCT measure column;
-    ``measure_index`` maps each agg onto those slots (None = identity)."""
+    ``measure_index`` maps each agg onto those slots (None = identity).
+
+    ``merge_mode`` shapes the result: ``device``/``psum`` return the merged
+    table (leaves ``[n_groups]``); ``host`` returns the UNMERGED per-device
+    partials (leaves ``[n_dev, n_groups]``) for the hostmerge fallback."""
     global _packed_fetch_broken
     import jax
 
+    from bqueryd_tpu.parallel import devicemerge
+
     pack = packed_fetch_enabled() and not _packed_fetch_broken
+    n_dev = int(mesh.devices.size)
     per_agg_measures = (
         measures_d
         if measure_index is None
@@ -1066,7 +1208,25 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             route=_route_key(),  # ditto: the flags steer the traced route
             strategy=strategy,  # planner hint: a different traced route too
             measure_index=measure_index,  # agg -> deduped block slot
+            merge_mode=merge_mode,  # the traced cross-device merge differs
         )
+
+    def finish(merged, fetched):
+        if merge_mode == devicemerge.MODE_DEVICE:
+            # axis-sharded span outputs concatenate to the padded table;
+            # the bucket pad tail holds no real group
+            merged = jax.tree_util.tree_map(
+                lambda a: a[: int(n_groups)], merged
+            )
+        elif merge_mode == devicemerge.MODE_HOST:
+            merged = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).reshape(n_dev, int(n_groups)),
+                merged,
+            )
+        _record_merge_bytes(
+            merge_mode, fetched, n_dev, int(n_groups), merged
+        )
+        return merged
 
     global _packed_transient_count
     latch_pending = False
@@ -1109,8 +1269,16 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             )
         else:
             _packed_transient_count = 0
-            leaves = _unpack_host(flat, spec["leaves"])
-            return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
+            if merge_mode == devicemerge.MODE_PSUM:
+                leaves = _unpack_host(flat, spec["leaves"])
+                merged = jax.tree_util.tree_unflatten(
+                    spec["treedef"], leaves
+                )
+            else:
+                merged = _assemble_sharded(
+                    flat, spec, n_dev, merge_mode
+                )
+            return finish(merged, flat.nbytes)
     program, _spec = run(False)
     with _collective_guard():
         result = jax.device_get(program(codes_d, *measures_d))
@@ -1124,4 +1292,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             "succeeded where the packed program failed); using per-leaf "
             "device_get for the process lifetime"
         )
-    return result
+    fetched = sum(
+        np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(result)
+    )
+    return finish(result, fetched)

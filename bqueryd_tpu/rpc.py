@@ -71,6 +71,10 @@ class RPC:
         #: latter — what the planner asked for vs what actually compiled)
         self.last_call_timings = None
         self.last_call_strategies = None
+        #: per-shard-group merge modes of the most recent groupby reply
+        #: ("device" = ICI-mesh collective merge, "host" = hostmerge
+        #: fallback, "none" = single payload) — how the answer was merged
+        self.last_call_merge_modes = None
         self.identity = os.urandom(8).hex()
         self.store = coordination_store(
             coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
@@ -211,6 +215,7 @@ class RPC:
         payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
         self.last_call_timings = envelope.get("timings")
         self.last_call_strategies = envelope.get("strategies")
+        self.last_call_merge_modes = envelope.get("merge_modes")
         if self.legacy_merge:
             return self._legacy_merge_frames(payloads)
         merged = hostmerge.merge_payloads(payloads)

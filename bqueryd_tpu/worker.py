@@ -808,6 +808,14 @@ class WorkerNode(WorkerBase):
             "bqueryd_tpu_worker_groupby_seconds",
             "whole-CalcMessage wall on the worker (open to serialize)",
         )
+        from bqueryd_tpu.obs.metrics import BYTES_BUCKETS
+
+        self.reply_bytes = self.metrics.histogram(
+            "bqueryd_tpu_reply_bytes",
+            "serialized groupby result-payload size per calc reply "
+            "(the wire bytes the device-resident merge shrinks)",
+            buckets=BYTES_BUCKETS,
+        )
         # the process-global compile/device profiler exposed on this node's
         # registry: compile-seconds histogram (same instance process-wide),
         # jit/persistent-cache counters, HBM watermark gauges
@@ -879,6 +887,33 @@ class WorkerNode(WorkerBase):
                 0 if self._mesh_executor is None
                 else self._mesh_executor.workingset.pressure_evictions
             ),
+        )
+
+        # device-resident merge byte movement (parallel/devicemerge): D2H
+        # bytes per merge mode and the per-device partial bytes the
+        # span-owned collective merge kept out of the fetch.  Process-global
+        # like the stage clocks — the worker owns the process's data path.
+        from bqueryd_tpu.parallel import devicemerge
+
+        for mode in ("device", "host"):
+            self.metrics.gauge(
+                "bqueryd_tpu_merge_bytes_fetched",
+                "D2H bytes fetched by the partial-table merge, per mode "
+                "(device = final spans only; host = every device's table)",
+                labels={"mode": mode},
+                fn=(lambda m=mode: devicemerge.stats().fetched(m)),
+            )
+            self.metrics.gauge(
+                "bqueryd_tpu_merge_queries",
+                "mesh queries merged per merge mode (monotonic)",
+                labels={"mode": mode},
+                fn=(lambda m=mode: devicemerge.stats().count(m)),
+            )
+        self.metrics.gauge(
+            "bqueryd_tpu_merge_d2h_bytes_saved",
+            "per-device partial-table bytes the device-resident merge kept "
+            "out of the D2H fetch (monotonic)",
+            fn=lambda: devicemerge.stats().saved(),
         )
 
         def result_stat(field):
@@ -1031,6 +1066,10 @@ class WorkerNode(WorkerBase):
         # kernel span (satellite: hints used to normalize silently and
         # nothing could tell what executed)
         self._last_effective_strategy = None
+        # how this query's partials merged ("device" = ICI-mesh collective,
+        # "host" = hostmerge.merge_payloads, "none" = single payload, no
+        # merge) — the reply envelope's ``merge_mode`` key
+        self._last_merge_mode = None
         total_rows = sum(int(t.nrows) for t in tables)
         # the same per-query cost estimate execute_local uses, worst shard
         # wins — a mismatched (optimistic) rate here would let slow-rated
@@ -1065,6 +1104,7 @@ class WorkerNode(WorkerBase):
                 self._last_effective_strategy = (
                     self.mesh_executor.last_effective_strategy
                 )
+                self._last_merge_mode = self.mesh_executor.last_merge_mode
                 return result
             except ops_mod.CompositeOverflow:
                 # the mesh alignment needs radix-packed composites; a key
@@ -1094,6 +1134,7 @@ class WorkerNode(WorkerBase):
             self._last_effective_strategy = (
                 self.engine.last_effective_strategy
             )
+            self._last_merge_mode = "none"  # one payload, nothing merged
             return result
         self.engine.timer = timer
         # pipelined per-shard fallback: shards run on the bounded pipeline
@@ -1111,6 +1152,7 @@ class WorkerNode(WorkerBase):
         # shards share one query shape, so the engine's last route speaks
         # for the group (a host/device split across shards reports the last)
         self._last_effective_strategy = self.engine.last_effective_strategy
+        self._last_merge_mode = "host"
         with timer.phase("hostmerge"):
             merged = hostmerge.merge_payloads(payloads)
         from bqueryd_tpu.models.query import ResultPayload
@@ -1218,6 +1260,7 @@ class WorkerNode(WorkerBase):
         # a result-cache hit compiled nothing: "cached" keeps the reply's
         # route report honest instead of silently dropping the key
         effective = "cached" if data is not None else None
+        merge_mode = None  # only freshly computed queries merged anything
         if data is None:
             import contextlib
 
@@ -1237,6 +1280,7 @@ class WorkerNode(WorkerBase):
                     tables, query, timer, strategy=strategy
                 )
             effective = getattr(self, "_last_effective_strategy", None)
+            merge_mode = getattr(self, "_last_merge_mode", None)
             if recorder is not None and effective:
                 # the kernel span carries what the executor actually
                 # compiled post-guards — rpc.trace() waterfalls can now
@@ -1273,6 +1317,12 @@ class WorkerNode(WorkerBase):
                 data = payload.to_bytes()
             if cache is not None and len(data) <= cache.max_bytes // 8:
                 cache.put(cache_key, data, nbytes=len(data))
+        if obs.enabled():
+            # result-payload size per reply — observed for cache hits too,
+            # so this histogram and its controller-side twin
+            # (reply_payload_bytes) count the same replies and the bench's
+            # merge section can cross-check them
+            self.reply_bytes.observe(len(data))
         # a result comparable to the worker's memory budget (1/32 of the
         # restart limit, 64 MB at the default 2 GB) means the query caches
         # are the next thing to evict
@@ -1312,6 +1362,14 @@ class WorkerNode(WorkerBase):
             # controller into the client result envelope and bench's
             # chosen_strategy
             reply["effective_strategy"] = effective
+        if merge_mode is not None:
+            # how this reply's partials merged: "device" (ICI-mesh
+            # collective, final table only fetched), "host"
+            # (hostmerge.merge_payloads — the kill switch / non-mergeable
+            # fallback), or "none" (single payload).  Declared in
+            # messages.ENVELOPE_SCHEMA; the controller folds it into the
+            # client result envelope's merge_modes
+            reply["merge_mode"] = merge_mode
         self.logger.debug("calc %s done: %s", filename, timer.as_dict())
         return reply
 

@@ -1,0 +1,207 @@
+"""Direct unit coverage of the host value-keyed merge — the fallback the
+``BQUERYD_TPU_DEVICE_MERGE`` kill switch (and every non-mergeable route)
+relies on (ISSUE 7 satellite).
+
+``hostmerge._union_distinct_flat`` (packed-int fast path, overflow fallback,
+string values, empty parts) and ``hostmerge._merge_partials`` (mixed
+float32/float64 measure widening, count_distinct set union, value_kinds
+reconciliation incl. pre-kinds payloads, shape disagreement) previously had
+only end-to-end coverage.
+"""
+
+import numpy as np
+import pytest
+
+from bqueryd_tpu.models.query import ResultPayload
+from bqueryd_tpu.parallel import hostmerge
+
+
+# -- _union_distinct_flat -----------------------------------------------------
+
+def _flat(mapping, n_groups):
+    """{gid: [values...]} -> (local_map, values, offsets) part."""
+    gids = sorted(mapping)
+    values = np.concatenate(
+        [np.asarray(mapping[g]) for g in gids]
+    ) if gids else np.empty(0, dtype=np.int64)
+    offsets = np.zeros(len(gids) + 1, dtype=np.int64)
+    np.cumsum([len(mapping[g]) for g in gids], out=offsets[1:])
+    return np.asarray(gids, dtype=np.int64), values, offsets
+
+
+def _sets(values, offsets):
+    return [
+        set(np.asarray(values[offsets[g]:offsets[g + 1]]).tolist())
+        for g in range(len(offsets) - 1)
+    ]
+
+
+def test_union_distinct_flat_int_fast_path():
+    a = _flat({0: [1, 2], 2: [5]}, 3)
+    b = _flat({1: [2], 2: [5, 7]}, 3)
+    values, offsets = hostmerge._union_distinct_flat([a, b], 3)
+    assert _sets(values, offsets) == [{1, 2}, {2}, {5, 7}]
+    assert offsets.tolist() == [0, 2, 3, 5]
+
+
+def test_union_distinct_flat_overflow_falls_back_to_unique():
+    """Values near int64 max force the packed-range path off (span
+    overflow); the np.unique fallback must union identically."""
+    big = 1 << 62
+    a = _flat({0: [-big, big], 1: [big]}, 2)
+    b = _flat({0: [big], 1: [-big]}, 2)
+    values, offsets = hostmerge._union_distinct_flat([a, b], 2)
+    assert _sets(values, offsets) == [{-big, big}, {-big, big}]
+
+
+def test_union_distinct_flat_string_values():
+    a = (np.array([0, 1]), np.array(["x", "y"], dtype=object),
+         np.array([0, 1, 2]))
+    b = (np.array([0]), np.array(["y"], dtype=object), np.array([0, 1]))
+    values, offsets = hostmerge._union_distinct_flat([a, b], 2)
+    assert _sets(values, offsets) == [{"x", "y"}, {"y"}]
+
+
+def test_union_distinct_flat_empty_parts():
+    empty = (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64),
+             np.zeros(1, dtype=np.int64))
+    values, offsets = hostmerge._union_distinct_flat([empty], 4)
+    assert len(values) == 0
+    assert offsets.tolist() == [0, 0, 0, 0, 0]
+    # one empty part beside a live one contributes nothing
+    live = _flat({3: [9]}, 4)
+    values, offsets = hostmerge._union_distinct_flat([empty, live], 4)
+    assert _sets(values, offsets) == [set(), set(), set(), {9}]
+
+
+def test_union_distinct_flat_spanning_values_counted_once():
+    """The reference's forced-'sum' merge double-counted values spanning
+    shards; the set union must not."""
+    a = _flat({0: [7, 8]}, 1)
+    b = _flat({0: [8, 9]}, 1)
+    values, offsets = hostmerge._union_distinct_flat([a, b], 1)
+    assert offsets[1] - offsets[0] == 3  # {7, 8, 9}, 8 counted once
+
+
+# -- _merge_partials ----------------------------------------------------------
+
+def _partials_payload(keys, rows, aggs, ops, out_cols, value_kinds=None,
+                      key_col="g"):
+    return ResultPayload.partials(
+        key_cols=[key_col],
+        keys={key_col: np.asarray(keys)},
+        rows=np.asarray(rows, dtype=np.int64),
+        aggs=aggs,
+        ops=ops,
+        out_cols=out_cols,
+        value_kinds=value_kinds,
+    )
+
+
+def test_merge_partials_widens_mixed_float_dtypes():
+    """A float32-summing shard merging with a float64 sibling must widen to
+    float64 (np.result_type), not truncate into parts[0]'s dtype."""
+    a = _partials_payload(
+        [0, 1], [2, 1],
+        [{"sum": np.array([1.5, 2.5], dtype=np.float32),
+          "count": np.array([2, 1], dtype=np.int64)}],
+        ["mean"], ["m"],
+    )
+    b = _partials_payload(
+        [1, 2], [1, 3],
+        [{"sum": np.array([0.25, 9.0], dtype=np.float64),
+          "count": np.array([1, 3], dtype=np.int64)}],
+        ["mean"], ["m"],
+    )
+    merged = hostmerge._merge_partials([a, b])
+    assert merged["aggs"][0]["sum"].dtype == np.float64
+    order, cols = hostmerge.finalize_table(merged)
+    got = dict(zip(cols["g"].tolist(), cols["m"].tolist()))
+    assert got[0] == pytest.approx(0.75)
+    assert got[1] == pytest.approx((2.5 + 0.25) / 2)
+    assert got[2] == pytest.approx(3.0)
+
+
+def test_merge_partials_count_distinct_union_plus_float_measure():
+    """The ISSUE's mixed case: count_distinct set parts merging by union
+    NEXT TO a float measure in the same payload pair."""
+    a = _partials_payload(
+        [0, 1], [2, 1],
+        [
+            {"distinct_values": np.array([10, 11]),
+             "distinct_offsets": np.array([0, 2, 2])},
+            {"sum": np.array([1.0, 2.0], dtype=np.float32)},
+        ],
+        ["count_distinct", "sum"], ["nd", "s"],
+    )
+    b = _partials_payload(
+        [0, 1], [1, 2],
+        [
+            {"distinct_values": np.array([11, 12, 13]),
+             "distinct_offsets": np.array([0, 1, 3])},
+            {"sum": np.array([0.5, 4.0], dtype=np.float64)},
+        ],
+        ["count_distinct", "sum"], ["nd", "s"],
+    )
+    merged = hostmerge._merge_partials([a, b])
+    order, cols = hostmerge.finalize_table(merged)
+    got_nd = dict(zip(cols["g"].tolist(), cols["nd"].tolist()))
+    assert got_nd == {0: 2, 1: 2}  # {10,11} and {12,13}; 11 union'd once
+    got_s = dict(zip(cols["g"].tolist(), cols["s"].tolist()))
+    assert got_s[0] == pytest.approx(1.5)
+    assert got_s[1] == pytest.approx(6.0)
+    assert merged["aggs"][1]["sum"].dtype == np.float64
+
+
+def test_merge_partials_value_kinds_reconciliation():
+    """uint64 next to a narrower-unsigned sibling keeps the unsigned view;
+    a payload with NO value_kinds (pre-kinds worker in a rolling restart)
+    merges as all-None; uint64 next to a signed/float sibling refuses."""
+    mk = lambda kinds: _partials_payload(
+        [0], [1], [{"sum": np.array([5], dtype=np.int64)}], ["sum"], ["s"],
+        value_kinds=kinds,
+    )
+    merged = hostmerge._merge_partials([mk(["uint64"]), mk(["uint"])])
+    assert merged["value_kinds"] == ["uint64"]
+
+    legacy = mk(None)
+    del legacy["value_kinds"]
+    merged = hostmerge._merge_partials([mk(["uint"]), legacy])
+    assert merged["value_kinds"] == [None]
+
+    with pytest.raises(ValueError, match="disagree"):
+        hostmerge._merge_partials([mk(["uint64"]), mk([None])])
+
+
+def test_merge_partials_rejects_shape_disagreement():
+    a = _partials_payload(
+        [0], [1], [{"sum": np.array([1], dtype=np.int64)}], ["sum"], ["s"],
+    )
+    b = _partials_payload(
+        [0], [1], [{"count": np.array([1], dtype=np.int64)}], ["count"],
+        ["n"],
+    )
+    with pytest.raises(ValueError, match="disagree"):
+        hostmerge._merge_partials([a, b])
+
+
+def test_merge_partials_min_max_extrema_fill_and_widening():
+    """min/max across differently-widthed shards: result_type widening must
+    not truncate a wider sibling's extrema into the fill range."""
+    a = _partials_payload(
+        [0, 1], [1, 1],
+        [{"min": np.array([5, -100], dtype=np.int8),
+          "count": np.array([1, 1], dtype=np.int64)}],
+        ["min"], ["lo"],
+    )
+    b = _partials_payload(
+        [0], [1],
+        [{"min": np.array([-70_000], dtype=np.int32),
+          "count": np.array([1], dtype=np.int64)}],
+        ["min"], ["lo"],
+    )
+    merged = hostmerge._merge_partials([a, b])
+    assert merged["aggs"][0]["min"].dtype == np.int32
+    order, cols = hostmerge.finalize_table(merged)
+    got = dict(zip(cols["g"].tolist(), cols["lo"].tolist()))
+    assert got == {0: -70_000, 1: -100}
