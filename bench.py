@@ -395,6 +395,296 @@ def device_roundtrip_floor():
     return min(walls)
 
 
+def _chaos_cluster(n_workers=2):
+    """Fresh controller + N replica calc workers over the bench dataset
+    (every worker holds every shard — the topology failover needs), with
+    failover-scaled timeouts.  One cluster per scenario: a killed or
+    wedged worker must not leak into the next scenario's measurement."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import WorkerNode
+
+    url = f"mem://chaos-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=DATA_DIR,
+        heartbeat_interval=0.1,
+        dead_worker_timeout=2.0,
+        dispatch_timeout=2.0,
+        dispatch_hard_timeout=4.0,
+    )
+    workers = [
+        WorkerNode(
+            coordination_url=url,
+            data_dir=DATA_DIR,
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.25,
+            poll_timeout=0.05,
+        )
+        for _ in range(n_workers)
+    ]
+    nodes = [controller] + workers
+    threads = [
+        threading.Thread(target=node.go, daemon=True) for node in nodes
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        # list(): the controller thread mutates files_map during worker
+        # registration while this poll iterates it
+        if len(controller.files_map) >= SHARDS and all(
+            len(holders) >= n_workers
+            for holders in list(controller.files_map.values())
+        ):
+            break
+        time.sleep(0.05)
+    else:
+        # stop the half-started cluster before raising: the caller never
+        # sees these nodes, and orphaned daemon threads would keep
+        # heartbeating under every later bench section
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+        raise RuntimeError("chaos cluster never reached replica topology")
+    rpc = RPC(coordination_url=url, timeout=60, loglevel=logging.WARNING)
+    return rpc, controller, workers, nodes, threads
+
+
+def _chaos_burst(rpc, names, repeats=3):
+    """The scenario workload: the headline sum + the multikey float-mean
+    query, interleaved ``repeats`` times.  Returns (walls, frames, failed)
+    — a query that raises counts as FAILED (the gate's currency) and the
+    burst continues."""
+    queries = {
+        "sharded_sum": config_query(HEADLINE, names),
+        "multikey_multiagg": config_query("multikey", names),
+    }
+    walls, frames, failed = [], {}, 0
+    for _ in range(repeats):
+        for qname, (f, g, a, w) in queries.items():
+            t0 = time.perf_counter()
+            try:
+                df = rpc.groupby(f, g, a, w)
+            except Exception as exc:
+                failed += 1
+                print(
+                    f"[bench] chaos: query {qname} FAILED: {exc!r}",
+                    file=sys.stderr, flush=True,
+                )
+                continue
+            walls.append(time.perf_counter() - t0)
+            frames.setdefault(qname, []).append(
+                df.sort_values(g).reset_index(drop=True)
+            )
+    return walls, frames, failed
+
+
+def _chaos_frames_match(frames, reference):
+    """Every burst frame vs the fault-free reference: integer columns
+    bit-identical, float columns within reassociation ulps (a failover that
+    re-splits a device-merge group changes float summation order only).
+    Returns (identical, float_max_rel_err)."""
+    identical, max_rel = True, 0.0
+    for qname, ref in reference.items():
+        for df in frames.get(qname, []):
+            if len(df) != len(ref) or list(df.columns) != list(ref.columns):
+                return False, max_rel
+            for col in ref.columns:
+                a = df[col].to_numpy()
+                b = ref[col].to_numpy()
+                if a.dtype.kind in "iub":
+                    identical = identical and bool(np.array_equal(a, b))
+                else:
+                    af = a.astype(np.float64)
+                    bf = b.astype(np.float64)
+                    identical = identical and bool(
+                        np.allclose(af, bf, rtol=1e-9, equal_nan=True)
+                    )
+                    with np.errstate(all="ignore"):
+                        rel = (
+                            np.nanmax(
+                                np.abs(af - bf)
+                                / np.maximum(np.abs(bf), 1e-30)
+                            )
+                            if len(af) else 0.0
+                        )
+                    max_rel = max(max_rel, float(rel))
+        if not frames.get(qname):
+            return False, max_rel  # the whole query family failed
+    return identical, max_rel
+
+
+def _chaos_scenario_plans(workers):
+    """The four scripted degradation scenarios over the replica cluster.
+    Built AFTER cluster start so the redis-partition rule can target one
+    concrete worker id; the others use times=1 (whichever worker draws the
+    first dispatch is the victim — deterministic given the plan + seed)."""
+    return {
+        "kill_worker": {
+            "seed": 81,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        },
+        "drop_reply": {
+            "seed": 82,
+            "faults": [{
+                "site": "controller.reply",
+                "action": "drop",
+                "times": 1,
+            }],
+        },
+        "wedge_device": {
+            "seed": 83,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "wedge",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        },
+        "redis_partition": {
+            "seed": 84,
+            "faults": [{
+                "site": "coordination.store",
+                "action": "partition",
+                "match": {"node": workers[0].worker_id},
+                "window_s": 6.0,
+            }],
+        },
+    }
+
+
+def run_chaos_section(names):
+    """The chaos gate: each scripted scenario (kill-worker, drop-reply,
+    wedge-device, redis-partition) runs the burst over its own fresh
+    replica cluster with the fault plan armed, asserting ZERO failed
+    queries, results identical to the fault-free run (ints bit-exact,
+    floats to reassociation ulps), bounded worst-case wall inflation, and
+    — via the summed failover counters — that the failover path actually
+    ran (no vacuous pass)."""
+    from bqueryd_tpu import chaos as chaos_mod
+
+    detail = {"scenarios": {}}
+    # fault-free reference: same burst, same cluster shape, no plan armed
+    rpc, controller, workers, nodes, threads = _chaos_cluster()
+    try:
+        _chaos_burst(rpc, names, repeats=1)  # warm compile/decode caches
+        ff_walls, ff_frames, ff_failed = _chaos_burst(rpc, names)
+    finally:
+        rpc.socket.close(linger=0)
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+    if ff_failed or not ff_walls:
+        raise RuntimeError("chaos fault-free baseline burst failed")
+    reference = {
+        qname: frames[0] for qname, frames in ff_frames.items()
+    }
+    ff_max = max(ff_walls)
+    detail["fault_free"] = {
+        "queries": len(ff_walls),
+        "max_wall_s": round(ff_max, 4),
+        "mean_wall_s": round(sum(ff_walls) / len(ff_walls), 4),
+    }
+
+    failovers_total = 0
+    for scenario in ("kill_worker", "drop_reply", "wedge_device",
+                     "redis_partition"):
+        rpc, controller, workers, nodes, threads = _chaos_cluster()
+        injected_before = chaos_mod.injected_total()
+        try:
+            _chaos_burst(rpc, names, repeats=1)  # warm, pre-fault
+            chaos_mod.arm(_chaos_scenario_plans(workers)[scenario])
+            walls, frames, failed = _chaos_burst(rpc, names)
+        finally:
+            chaos_mod.disarm()
+            rpc.socket.close(linger=0)
+            for node in nodes:
+                node.running = False
+            for t in threads:
+                t.join(timeout=5)
+        identical, max_rel = _chaos_frames_match(frames, reference)
+        counters = dict(controller.counters)
+        failovers = counters.get("failover_dispatches", 0)
+        failovers_total += failovers
+        max_wall = max(walls) if walls else None
+        entry = {
+            "queries": len(walls) + failed,
+            "failed": failed,
+            "max_wall_s": None if max_wall is None else round(max_wall, 4),
+            "p99_inflation_x": (
+                None if max_wall is None or ff_max <= 0
+                else round(max_wall / ff_max, 2)
+            ),
+            # worst-case inflation bound: one full recovery window
+            # (dispatch timeout -> failover backoff -> re-execute) + slack;
+            # an unbounded stall means the failover path did NOT recover
+            "bounded_p99": (
+                max_wall is not None and max_wall <= ff_max + 20.0
+            ),
+            "identical": identical,
+            "float_max_rel_err": max_rel,
+            "failover_dispatches": failovers,
+            "transient_faults": counters.get("transient_faults", 0),
+            "duplicate_replies": counters.get("duplicate_replies", 0),
+            "fault_injected": chaos_mod.injected_total() - injected_before,
+        }
+        detail["scenarios"][scenario] = entry
+        print(
+            f"[bench] chaos {scenario}: failed={failed} "
+            f"max_wall={entry['max_wall_s']}s "
+            f"(x{entry['p99_inflation_x']} vs fault-free) "
+            f"identical={identical} failovers={failovers} "
+            f"injected={entry['fault_injected']}",
+            file=sys.stderr, flush=True,
+        )
+
+    detail["zero_failed_queries"] = all(
+        s["failed"] == 0 for s in detail["scenarios"].values()
+    )
+    detail["failover_dispatches_total"] = failovers_total
+    detail["note"] = (
+        "each scenario: fresh 2-replica cluster, fault plan armed "
+        "(bqueryd_tpu.chaos), 6-query burst; gate = zero failed queries, "
+        "results identical to the fault-free run (ints bit-exact, floats "
+        "reassociation-ulp), bounded worst-case wall, and "
+        "failover_dispatches > 0 overall (no vacuous pass)"
+    )
+    if os.environ.get("BENCH_CHAOS_GATE", "1") == "1":
+        assert detail["zero_failed_queries"], (
+            f"chaos gate: queries failed under fault injection: "
+            f"{ {k: v['failed'] for k, v in detail['scenarios'].items()} }"
+        )
+        for scenario, entry in detail["scenarios"].items():
+            assert entry["identical"], (
+                f"chaos gate: {scenario} results diverged from the "
+                f"fault-free run (float_max_rel_err "
+                f"{entry['float_max_rel_err']})"
+            )
+            assert entry["bounded_p99"], (
+                f"chaos gate: {scenario} worst wall {entry['max_wall_s']}s "
+                f"blew the bounded-inflation window"
+            )
+            assert entry["fault_injected"] > 0, (
+                f"chaos gate: {scenario} injected no faults — the "
+                f"scenario measured nothing"
+            )
+        assert failovers_total > 0, (
+            "chaos gate: failover_dispatches never moved — the failover "
+            "path was not exercised (vacuous pass)"
+        )
+    return detail
+
+
 def _clear_worker_caches(worker):
     """Cold-path reset: drop the worker's data caches (storage decode,
     alignment, HBM blocks, serialized results).  Compiled XLA programs stay —
@@ -485,6 +775,9 @@ def main():
             # a pre-pinned pool width would turn the pipeline section's
             # serialized-vs-pipelined comparison into a self-comparison
             "BQUERYD_TPU_PIPELINE_THREADS",
+            # an armed fault plan would inject into the MAIN measurement
+            # clusters; the chaos section arms its own plans per scenario
+            "BQUERYD_TPU_FAULT_PLAN",
         )
     }
     base_dfs = {}  # per-config baseline frames for the variant gates
@@ -1508,6 +1801,36 @@ def main():
                 else:
                     os.environ["BQUERYD_TPU_DEVICE_MERGE"] = prior_dm
 
+        # chaos: the zero-failed-query degradation gate — scripted
+        # kill-worker / drop-reply / wedge-device / redis-partition
+        # scenarios over fresh 2-replica clusters of the same dataset,
+        # results diffed against a fault-free run (ints bit-exact, floats
+        # reassociation-ulp), failover counters proving the path ran.
+        # With BQUERYD_TPU_FAULT_PLAN unset (popped above), every
+        # injection site in the MAIN measurements above was a no-op.
+        chaos_detail = {}
+        if (
+            os.environ.get("BENCH_CHAOS", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            try:
+                chaos_detail = run_chaos_section(names)
+            except AssertionError:
+                raise  # the chaos gate is deterministic: fail the bench
+            except Exception as exc:
+                if os.environ.get("BENCH_CHAOS_GATE", "1") == "1":
+                    # the gate's assertions live inside run_chaos_section —
+                    # a setup crash (cluster bring-up timeout, baseline
+                    # burst failure) must fail the armed gate, not record
+                    # chaos={} and read as green
+                    raise
+                print(
+                    f"[bench] chaos section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         # -- static-analysis guard: suite runtime + per-family finding
         # counts (proves the full pass stays interactive — a few seconds —
         # and that the tree the bench measured was lint-clean)
@@ -1600,6 +1923,9 @@ def main():
             # DEVICE_MERGE=0 host-gather payload bytes, the <=10% gate,
             # and the =1 vs =0 parity probes
             "merge": merge_detail,
+            # fault-injection scenarios: zero-failed-query gate, result
+            # parity vs the fault-free run, failover/hedge counters
+            "chaos": chaos_detail,
             # suite runtime + per-family finding counts (the bench guard
             # proving the full static pass stays under a few seconds)
             "static_analysis": static_analysis_detail,
@@ -1663,6 +1989,12 @@ def main():
                             "overlap_ratio"
                         ),
                         "merge_d2h_ratio": merge_detail.get("d2h_ratio"),
+                        "chaos_zero_failed": chaos_detail.get(
+                            "zero_failed_queries"
+                        ),
+                        "chaos_failovers": chaos_detail.get(
+                            "failover_dispatches_total"
+                        ),
                         "jit_cache_hit_rate": profiling_detail.get(
                             "jit_cache_hit_rate"
                         ),

@@ -234,6 +234,34 @@ ENV_REGISTRY = {
            "dispatch deprioritizes degraded/wedged workers (0 = score only)"),
         _v("DEBUG_DIR", "path", "tmpdir",
            "where SIGUSR1 debug bundles are written"),
+        _v("DEAD_WORKER_TIMEOUT", "float", "60",
+           "cull workers silent longer than this many seconds",
+           related=("DISPATCH_TIMEOUT", "DISPATCH_HARD_TIMEOUT")),
+        _v("DISPATCH_TIMEOUT", "float", "120",
+           "re-queue (fail over) in-flight shard work older than this many "
+           "seconds when its worker stopped heartbeating",
+           related=("DEAD_WORKER_TIMEOUT", "DISPATCH_HARD_TIMEOUT",
+                    "MAX_DISPATCH_RETRIES")),
+        _v("DISPATCH_HARD_TIMEOUT", "float", "1800",
+           "re-queue in-flight shard work older than this many seconds even "
+           "on a live, heartbeating worker (wedged-but-alive reclaim)",
+           related=("DISPATCH_TIMEOUT", "DEAD_WORKER_TIMEOUT")),
+        _v("MAX_DISPATCH_RETRIES", "int", "2",
+           "failover attempts per shard before the query aborts with the "
+           "structured DispatchExhausted envelope",
+           related=("DISPATCH_TIMEOUT",)),
+        _v("FAULT_PLAN", "str", "-",
+           "arm deterministic fault injection: a FaultPlan JSON file path "
+           "or inline JSON (bqueryd_tpu.chaos); unset = every injection "
+           "site is a no-op"),
+        _v("HEDGE_MS", "float", "0",
+           "duplicate a tail shard still inflight past this many ms onto a "
+           "second healthy holder, first reply wins (0 = hedging off)"),
+        _v("REPLICA_FACTOR", "int", "0 (all nodes)",
+           "placement hint: holders per shard — download fan-out targets "
+           "this many nodes per file (0 = every node, the historical "
+           "full fan-out); under-replicated shards surface in "
+           "rpc.info()['replication'] (failover needs >=2)"),
     ]
 }
 

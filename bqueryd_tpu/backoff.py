@@ -1,0 +1,28 @@
+"""Shared retry pacing: capped exponential backoff with deterministic jitter.
+
+One formula for every retry loop in the stack — the RPC client's call
+retries (timeouts, reconnects, BUSY backpressure) and the controller's
+failover dispatch attempts — so tuning the envelope changes both sides
+together instead of silently desynchronizing them.
+
+The jitter is **deterministic**: keyed on a caller-supplied seed (socket
+identity, work token) via crc32, so a thundering herd of retrying peers
+de-stampedes the same way on every run and chaos scenarios replay
+bit-for-bit.  Stdlib only; importable everywhere (including the jax-free
+controller).
+"""
+
+import zlib
+
+#: default envelope: base * 2^exponent, capped
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 2.0
+
+
+def backoff_delay(exponent, seed_key, base=BACKOFF_BASE_S, cap=BACKOFF_CAP_S):
+    """Delay before the attempt after ``exponent`` failures: ``base *
+    2^exponent`` capped at ``cap``, stretched by up to 25% keyed on
+    ``seed_key`` — stable across re-runs, distinct across keys."""
+    delay = min(base * (2 ** exponent), cap)
+    jitter = (zlib.crc32(str(seed_key).encode()) % 256) / 1024.0  # [0, 0.25)
+    return delay * (1.0 + jitter)

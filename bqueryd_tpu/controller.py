@@ -33,7 +33,7 @@ import time
 import zmq
 
 import bqueryd_tpu
-from bqueryd_tpu import messages
+from bqueryd_tpu import backoff, chaos, messages
 from bqueryd_tpu.coordination import coordination_store
 from bqueryd_tpu.messages import (
     BusyMessage,
@@ -47,6 +47,7 @@ from bqueryd_tpu.messages import (
     WorkerRegisterMessage,
     msg_factory,
 )
+from bqueryd_tpu.utils.env import env_num
 from bqueryd_tpu.utils.net import bind_to_random_port, get_my_ip
 
 POLLING_TIMEOUT = 0.5        # seconds
@@ -56,6 +57,18 @@ DISPATCH_TIMEOUT = 120.0     # re-queue in-flight work after this
 DISPATCH_HARD_TIMEOUT = 1800.0  # ...even if the worker still heartbeats
 MAX_DISPATCH_RETRIES = 2
 RUNFILE_DIR = os.environ.get("BQUERYD_TPU_RUNFILE_DIR", "/srv")
+#: failover pacing: exponential backoff between dispatch attempts of one
+#: shard (base * 2^retries, capped) plus a deterministic per-token jitter so
+#: a burst of simultaneous failovers doesn't stampede the surviving holder
+#: (shared formula: bqueryd_tpu.backoff — the RPC client retries use it too)
+RETRY_BACKOFF_BASE_S = backoff.BACKOFF_BASE_S
+RETRY_BACKOFF_CAP_S = backoff.BACKOFF_CAP_S
+
+
+# env-tunable timing knobs: the registered BQUERYD_TPU_* override when
+# parseable, the module-constant default otherwise (chaos scenarios and
+# small test clusters shrink these without monkeypatching)
+_env_num = env_num
 
 CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
@@ -88,6 +101,20 @@ COUNTER_SPECS = {
     "reply_payload_bytes":
         "cumulative result-payload bytes received in worker calc replies "
         "(the controller-side twin of the worker's reply_bytes histogram)",
+    "failover_dispatches":
+        "shards re-queued after a worker loss, timeout, or transient fault "
+        "(the retry excludes the failed holder)",
+    "transient_faults":
+        "transient (retryable) worker error replies that triggered a "
+        "shard failover instead of a query abort",
+    "hedged_dispatches":
+        "duplicate tail-shard dispatches issued past BQUERYD_TPU_HEDGE_MS",
+    "hedge_wins":
+        "hedged dispatches whose duplicate replied before the original",
+    "duplicate_replies":
+        "worker replies deduplicated by query token (hedge losers, "
+        "late retries, chaos-duplicated envelopes) — counted, never "
+        "double-merged",
 }
 
 
@@ -99,24 +126,65 @@ class ControllerNode:
         loglevel=None,
         runfile_dir=RUNFILE_DIR,
         heartbeat_interval=HEARTBEAT_INTERVAL,
-        dead_worker_timeout=DEAD_WORKER_TIMEOUT,
-        dispatch_timeout=DISPATCH_TIMEOUT,
-        dispatch_hard_timeout=DISPATCH_HARD_TIMEOUT,
+        dead_worker_timeout=None,
+        dispatch_timeout=None,
+        dispatch_hard_timeout=None,
         port_range=(14300, 14400),
         admit_max_active=None,
         admit_queue_depth=None,
         admit_client_quota=None,
+        max_dispatch_retries=None,
+        hedge_ms=None,
     ):
         import logging
 
         bqueryd_tpu.configure_logging(loglevel or logging.INFO)
+        # fault injection (bqueryd_tpu.chaos): armed only when
+        # BQUERYD_TPU_FAULT_PLAN is set; every injection site below is a
+        # single None check otherwise
+        chaos.maybe_arm_from_env()
         self.store = coordination_store(
             coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
         )
         self.heartbeat_interval = heartbeat_interval
+        # timing knobs resolve ctor arg -> registered env var -> module
+        # constant, so chaos scenarios can shrink them per process
+        if dead_worker_timeout is None:
+            dead_worker_timeout = _env_num(
+                "BQUERYD_TPU_DEAD_WORKER_TIMEOUT", DEAD_WORKER_TIMEOUT
+            )
+        if dispatch_timeout is None:
+            dispatch_timeout = _env_num(
+                "BQUERYD_TPU_DISPATCH_TIMEOUT", DISPATCH_TIMEOUT
+            )
+        if dispatch_hard_timeout is None:
+            dispatch_hard_timeout = _env_num(
+                "BQUERYD_TPU_DISPATCH_HARD_TIMEOUT", DISPATCH_HARD_TIMEOUT
+            )
         self.dead_worker_timeout = dead_worker_timeout
         self.dispatch_timeout = dispatch_timeout
         self.dispatch_hard_timeout = max(dispatch_hard_timeout, dispatch_timeout)
+        self.max_dispatch_retries = (
+            max_dispatch_retries
+            if max_dispatch_retries is not None
+            else _env_num(
+                "BQUERYD_TPU_MAX_DISPATCH_RETRIES", MAX_DISPATCH_RETRIES, int
+            )
+        )
+        # hedged duplicate dispatch for tail shards: 0 (the default) is OFF;
+        # >0 duplicates a shard still inflight past this many milliseconds
+        # onto a second healthy holder, first reply wins (dedup by token)
+        self.hedge_ms = (
+            hedge_ms if hedge_ms is not None
+            else _env_num("BQUERYD_TPU_HEDGE_MS", 0.0)
+        )
+        # replica placement hint: download fan-out targets this many holders
+        # per shard (0 = every node, the historical behaviour; see
+        # download.setup_download); surfaced in get_info and the
+        # replica_holders gauges so under-replication is visible
+        self.replica_factor = max(
+            _env_num("BQUERYD_TPU_REPLICA_FACTOR", 0, int), 0
+        )
 
         self.context = zmq.Context.instance()
         self.socket = self.context.socket(zmq.ROUTER)
@@ -142,6 +210,15 @@ class ControllerNode:
         self._affinity_rr = 0
         self.rpc_segments = {}        # parent_token -> fan-out bookkeeping
         self.inflight = {}            # shard token -> dict(worker, sent_at, msg, parent)
+        self._hedged_tokens = {}      # token -> hedge ts (late-reply dedup)
+        self._hedge_losers = {}       # token -> dict(workers, since): reclaim
+        #                               handle on the non-winning side of a
+        #                               hedge (its inflight entry is gone)
+        self._requeued_tokens = set()  # retries parked in the dispatch queue
+        #                                (backoff window): a late reply from
+        #                                the failed attempt must not abort
+        #                                or double-execute past them
+        self._holder_counts_memo = None  # (ts, counts) scrape-window memo
         # -- planning & admission state -------------------------------------
         from bqueryd_tpu.plan import AdmissionController
 
@@ -189,6 +266,22 @@ class ControllerNode:
             "bqueryd_tpu_workers_known",
             "workers currently registered", fn=lambda: len(self.worker_map),
         )
+        self.metrics.gauge(
+            "bqueryd_tpu_fault_injected_total",
+            "faults injected by the armed chaos plan, process-lifetime "
+            "(0 while BQUERYD_TPU_FAULT_PLAN is unarmed)",
+            fn=chaos.injected_total,
+        )
+        # replica visibility: shards by live holder count — failover needs
+        # at least 2 holders, so the holders="1" gauge is the pager signal
+        for bucket in ("1", "2", "3plus"):
+            self.metrics.gauge(
+                "bqueryd_tpu_replica_holders",
+                "advertised shards by live holder count (failover needs a "
+                "second holder; see BQUERYD_TPU_REPLICA_FACTOR)",
+                labels={"holders": bucket},
+                fn=(lambda b=bucket: self._holder_counts().get(b, 0)),
+            )
         self.query_seconds = self.metrics.histogram(
             "bqueryd_tpu_groupby_seconds",
             "end-to-end groupby wall at the controller (admission to reply)",
@@ -307,6 +400,7 @@ class ControllerNode:
                     self.heartbeat()
                     self.free_dead_workers()
                     self.retry_stale_dispatches()
+                    self.maybe_hedge()
                     events = dict(self.poller.poll(int(POLLING_TIMEOUT * 1000)))
                     if self.socket in events:
                         # drain everything available this tick
@@ -438,11 +532,19 @@ class ControllerNode:
             if not self.files_map[filename]:
                 del self.files_map[filename]
                 self.shard_stats.pop(filename, None)
-        # re-queue anything in flight on that worker
+        # re-queue anything in flight on that worker; a hedged flight
+        # collapses onto its surviving side instead (the duplicate is
+        # still computing — a fresh dispatch would be redundant)
         for token, entry in list(self.inflight.items()):
-            if entry["worker"] == worker_id:
+            if entry.get("hedged") == worker_id:
                 self.inflight.pop(token)
-                self._requeue(entry)
+                self._collapse_hedge(token, entry, worker_id)
+            elif entry["worker"] == worker_id:
+                self.inflight.pop(token)
+                if entry.get("hedged"):
+                    self._collapse_hedge(token, entry, worker_id)
+                else:
+                    self._requeue(entry)
 
     def _absorb_worker_metrics(self, worker_id, info):
         """Latest histogram snapshot per worker (rides the WRM like shard
@@ -533,8 +635,30 @@ class ControllerNode:
                     "calibration gossip absorb failed", exc_info=True
                 )
 
+    def _holder_counts(self):
+        """Advertised shards bucketed by live holder count ("1"/"2"/"3plus")
+        — the replica_holders gauge family and get_info's replication view.
+        Briefly memoized: one metrics scrape reads all three buckets (and
+        get_info a fourth), which would otherwise walk files_map once per
+        bucket."""
+        now = time.time()
+        cached = self._holder_counts_memo
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        counts = {"1": 0, "2": 0, "3plus": 0}
+        # list(): gauges render on the metrics HTTP thread while the main
+        # loop mutates files_map (WRM registration, worker cull)
+        for holders in list(self.files_map.values()):
+            n = len(holders)
+            if n >= 3:
+                counts["3plus"] += 1
+            elif n:
+                counts[str(n)] += 1
+        self._holder_counts_memo = (now, counts)
+        return counts
+
     # -- scheduling --------------------------------------------------------
-    def find_free_worker(self, needs_local=False, filename=None):
+    def find_free_worker(self, needs_local=False, filename=None, exclude=()):
         """Random choice among free calc workers, constrained to workers
         advertising ``filename`` — a single name or, for a batched shard
         group, a list the worker must advertise in full — and optionally to
@@ -545,7 +669,13 @@ class ControllerNode:
         degraded/wedged are used only when no healthy candidate is free —
         deprioritized, never excluded, so the sole holder of a shard still
         serves it.  ``BQUERYD_TPU_HEALTH_ROUTING=0`` disables the
-        preference."""
+        preference.
+
+        ``exclude`` is the failover set: holders this shard already failed
+        on.  They are avoided while ANY other candidate exists, but — same
+        rule as health routing — a shard whose only remaining holder is
+        excluded is still served by it (a transient fault may have cleared;
+        refusing outright would turn every sole-holder hiccup terminal)."""
         from bqueryd_tpu.obs import health as health_mod
 
         needed = (
@@ -562,6 +692,10 @@ class ControllerNode:
             if needs_local and info.get("node") != self.node_name:
                 continue
             candidates.append(worker_id)
+        if exclude:
+            kept = [w for w in candidates if w not in exclude]
+            if kept:
+                candidates = kept
         if not candidates:
             return None
         if len(candidates) > 1 and health_mod.routing_enabled():
@@ -586,10 +720,24 @@ class ControllerNode:
                 if affinity is not None:
                     self.worker_out_messages.pop(affinity, None)
                 continue
-            msg = queue[0]
+            # one action per queue per tick, but a shard inside its failover
+            # backoff window must not head-of-line block the messages queued
+            # behind it (workers may be free for THEM) — scan for the first
+            # actionable message instead of only ever examining the head
+            now = time.time()
+            idx = None
+            for i, msg in enumerate(queue):
+                not_before = msg.get("_not_before")
+                if not_before is not None and not_before > now:
+                    continue  # backing off: skip it, don't block the queue
+                idx = i
+                break
+            if idx is None:
+                continue  # whole queue is backing off: retry next tick
+            msg = queue[idx]
             if msg.deadline_expired():
                 # nobody is waiting anymore: expire instead of dispatching
-                queue.pop(0)
+                queue.pop(idx)
                 self.counters["deadline_expired"] += 1
                 self._abort_work(
                     msg, "deadline exceeded before dispatch"
@@ -598,6 +746,7 @@ class ControllerNode:
             worker_id = msg.get("worker_id") or self.find_free_worker(
                 needs_local=msg.get("needs_local", False),
                 filename=msg.get("filename"),
+                exclude=frozenset(msg.get("_excluded_workers") or ()),
             )
             if worker_id is None:
                 filename = msg.get("filename")
@@ -610,7 +759,7 @@ class ControllerNode:
                     # the file vanished from every worker (all holders died):
                     # no future tick can serve this — fail fast instead of
                     # head-of-line-blocking the queue forever
-                    queue.pop(0)
+                    queue.pop(idx)
                     self._abort_work(
                         msg,
                         f"file(s) no longer on any worker: "
@@ -622,12 +771,12 @@ class ControllerNode:
                     # placement changed since batching (e.g. the co-locating
                     # worker died): re-split the group into per-shard
                     # messages, which the normal scheduler can place
-                    queue.pop(0)
+                    queue.pop(idx)
                     children = self._split_batch(msg)
                     self._transfer_work(msg, children)
                     queue.extend(children)
                 continue  # retry next tick
-            queue.pop(0)
+            queue.pop(idx)
             self._send_to_worker(worker_id, msg)
         self._affinity_rr += 1
 
@@ -680,6 +829,7 @@ class ControllerNode:
 
     def _drop_work(self, token):
         self._work_subscribers.pop(token, None)
+        self._requeued_tokens.discard(token)
         key = self._work_keys.pop(token, None)
         if key is not None and self._work_index.get(key) == token:
             self._work_index.pop(key, None)
@@ -701,18 +851,52 @@ class ControllerNode:
         for child in children:
             self._register_work(child, subs)
 
-    def _abort_work(self, msg, error_text):
+    def _abort_work(self, msg, error_text, error_class=None, attempts=None):
         """Fail every parent subscribed to one work unit."""
         parents = self._work_parents(msg)
         self._drop_work(msg.get("token"))
         for parent in parents:
-            self.abort_parent(parent, error_text)
+            self.abort_parent(
+                parent, error_text,
+                error_class=error_class, attempts=attempts,
+            )
 
-    def _send_to_worker(self, worker_id, msg):
-        try:
+    def _dispatch_wire(self, worker_id, msg):
+        """The low-level dispatch seam shared by the primary and hedge
+        paths: the controller.dispatch chaos site (drop / duplicate /
+        delay) plus the raw ROUTER send.  Returns False when the envelope
+        was chaos-dropped (recorded here; callers decide whether that
+        means 'lost on the wire' or 'never sent'); zmq.ZMQError from a
+        gone peer propagates to the caller."""
+        fault = chaos.fire(
+            "controller.dispatch",
+            worker=worker_id,
+            verb=msg.get("payload"),
+            token=msg.get("token"),
+            filename=str(msg.get("filename")),
+        ) if chaos.enabled() else None
+        if fault is not None and fault.action == "drop":
+            self.flight.record(
+                "chaos_dispatch_dropped",
+                worker=worker_id, token=msg.get("token"),
+            )
+            return False
+        self.socket.send_multipart(
+            [worker_id.encode(), msg.to_json().encode()]
+        )
+        if fault is not None and fault.action == "duplicate":
             self.socket.send_multipart(
                 [worker_id.encode(), msg.to_json().encode()]
             )
+        return True
+
+    def _send_to_worker(self, worker_id, msg):
+        # chaos site controller.dispatch: drop (the envelope "leaves" but
+        # never arrives — the dispatch-timeout/failover path must recover),
+        # duplicate (the worker sees the work twice — reply dedup must
+        # hold), delay (handled inside fire)
+        try:
+            self._dispatch_wire(worker_id, msg)
         except zmq.ZMQError as exc:
             self.logger.warning("send to worker %s failed: %s", worker_id, exc)
             self.remove_worker(worker_id)
@@ -729,6 +913,8 @@ class ControllerNode:
                 {"msg": msg, "retries": msg.get("_retries", 0),
                  "parent": msg.get("parent_token")},
                 charge_retry=not unroutable,
+                failed_worker=worker_id,
+                reason=f"send failed: {exc}",
             )
             return
         if msg.isa("groupby"):
@@ -755,6 +941,7 @@ class ControllerNode:
             self.worker_map[worker_id]["last_seen"] = time.time()
         token = msg.get("token")
         if token:
+            self._requeued_tokens.discard(token)
             self.inflight[token] = {
                 "worker": worker_id,
                 "sent_at": time.time(),
@@ -831,7 +1018,18 @@ class ControllerNode:
                 trace_id=(entry["msg"].get_trace() or {}).get("trace_id"),
             )
             self.inflight.pop(token)
-            self._requeue(entry)
+            if entry.get("hedged"):
+                # the original side timed out while its hedge duplicate is
+                # still computing: collapse onto the survivor instead of a
+                # redundant third dispatch (the survivor keeps its own
+                # freshly-rebased timeout clock)
+                self._collapse_hedge(token, entry, entry["worker"])
+            else:
+                self._requeue(
+                    entry,
+                    reason=f"dispatch timeout after {age:.0f}s "
+                           f"(worker {'alive' if worker_alive else 'dead'})",
+                )
             if worker_alive:
                 # heartbeating but wedged past the hard cap: reclaim it fully
                 # (drop its files_map entries + requeue its other inflight)
@@ -841,18 +1039,224 @@ class ControllerNode:
                     "worker %s hung past hard timeout, removing", entry["worker"]
                 )
                 self.remove_worker(entry["worker"])
+        # outdistanced workers (hedge losers, stale-attempt holders a late
+        # first-worker reply beat) have no inflight entry — the winning
+        # reply popped it — but may still be wedged mid-execution: past the
+        # hard cap, reclaim each exactly like a hung dispatch.  Their shard
+        # is already answered, so there is nothing to requeue for THIS token
+        for token, rec in list(self._hedge_losers.items()):
+            remaining = []
+            for worker in rec["workers"]:
+                if worker not in self.worker_map:
+                    continue  # culled independently
+                age = now - rec["since"]
+                if age <= self.dispatch_hard_timeout:
+                    remaining.append(worker)
+                    continue
+                self.logger.warning(
+                    "hedge loser %s silent past hard timeout on %s, removing",
+                    worker, token,
+                )
+                self.flight.record(
+                    "hedge_loser_timeout",
+                    token=token, worker=worker, age_s=round(age, 3),
+                )
+                self.remove_worker(worker)
+            if remaining:
+                rec["workers"] = remaining
+            else:
+                self._hedge_losers.pop(token, None)
 
-    def _requeue(self, entry, charge_retry=True):
+    def _mark_hedged(self, token, ts):
+        """Record a token in the late-reply dedup ring, bounded: markers
+        for workers that die before answering are never popped by a reply,
+        so the cap (not the pop) is what keeps a long-lived controller's
+        memory flat."""
+        self._hedged_tokens[token] = ts
+        while len(self._hedged_tokens) > 256:
+            self._hedged_tokens.pop(next(iter(self._hedged_tokens)))
+
+    def _withdraw_queued(self, token):
+        """Remove a not-yet-dispatched queued work message by token: its
+        query was answered by a late reply from a previous attempt, so
+        dispatching it would only burn a worker on a finished shard."""
+        for affinity, queue in list(self.worker_out_messages.items()):
+            kept = [m for m in queue if m.get("token") != token]
+            if len(kept) != len(queue):
+                self.worker_out_messages[affinity] = kept
+
+    def _collapse_hedge(self, token, entry, failed_worker):
+        """One side of a hedged pair is gone (transient fault, timeout,
+        cull): re-key the inflight entry onto the surviving side instead of
+        requeueing — a third execution would be redundant while the
+        duplicate lives, and the survivor needs a hard-timeout reclaim
+        handle.  Clears the token's hedge dedup marker: the flight is no
+        longer hedged, so the survivor's reply must be processed as THE
+        reply, not deduplicated."""
+        hedged = entry.get("hedged")
+        survivor = hedged if failed_worker == entry.get("worker") \
+            else entry["worker"]
+        refiled = dict(entry, worker=survivor)
+        refiled.pop("hedged", None)
+        refiled.pop("hedged_at", None)
+        if survivor == hedged:
+            # timeout clock restarts at the hedge dispatch, not the
+            # original one, or the survivor is reclaimed the moment it
+            # inherits the entry
+            refiled["sent_at"] = entry.get("hedged_at", entry["sent_at"])
+        excluded = list(entry["msg"].get("_excluded_workers") or [])
+        if failed_worker and failed_worker not in excluded:
+            excluded.append(failed_worker)
+        entry["msg"]["_excluded_workers"] = excluded
+        self._hedged_tokens.pop(token, None)
+        self.inflight[token] = refiled
+        self.flight.record(
+            "hedge_collapsed",
+            token=token, failed=failed_worker, survivor=survivor,
+        )
+        return refiled
+
+    def _note_losers(self, token, workers):
+        """Keep hard-timeout reclaim handles on workers still computing an
+        already-answered token (hedge losers, outdistanced stale attempts):
+        retry_stale_dispatches reclaims them like any hung dispatch, and a
+        loser that answers after all is discarded from tracking."""
+        workers = [w for w in workers if w]
+        if workers:
+            self._hedge_losers[token] = {
+                "workers": workers, "since": time.time(),
+            }
+
+    def _discard_loser(self, token, worker_id):
+        """A tracked loser answered after all — stop holding a reclaim
+        handle on it (others computing the same token stay tracked)."""
+        rec = self._hedge_losers.get(token)
+        if rec is None:
+            return
+        rec["workers"] = [w for w in rec["workers"] if w != worker_id]
+        if not rec["workers"]:
+            self._hedge_losers.pop(token, None)
+
+    def maybe_hedge(self):
+        """Hedged duplicate dispatch for tail shards (off unless
+        ``BQUERYD_TPU_HEDGE_MS`` > 0): a shard still inflight past the
+        threshold is duplicated onto a second healthy holder (excluding the
+        original and every previously failed one).  First reply wins; the
+        loser's reply is deduplicated by query token and **counted**
+        (``duplicate_replies``), never double-merged — results are keyed by
+        shard filename, so a duplicate could only ever overwrite its own
+        identical payload."""
+        if self.hedge_ms <= 0 or not self.inflight:
+            return
+        now = time.time()
+        for token, entry in list(self.inflight.items()):
+            if token not in self.inflight:
+                # remove_worker() below (gone hedge target) requeues that
+                # worker's other entries mid-iteration: a snapshot item no
+                # longer inflight must not be hedged — its retry is parked,
+                # and a ring marker here would discard the retry's valid
+                # reply as a duplicate (same guard as
+                # retry_stale_dispatches)
+                continue
+            if entry.get("hedged"):
+                continue
+            if (now - entry["sent_at"]) * 1000.0 < self.hedge_ms:
+                continue
+            msg = entry["msg"]
+            if not msg.isa("groupby") or msg.get("worker_id"):
+                # hedging duplicates EXECUTION: only the idempotent shard
+                # verb is safe to run twice (execute_code & co. carry side
+                # effects), and a worker-pinned message chose its target
+                continue
+            exclude = {entry["worker"]} | set(
+                msg.get("_excluded_workers") or ()
+            )
+            target = self.find_free_worker(
+                needs_local=msg.get("needs_local", False),
+                filename=msg.get("filename"),
+                exclude=exclude,
+            )
+            if target is None or target in exclude:
+                continue  # no second healthy holder free right now
+            # the hedge rides the same chaos dispatch site as the primary
+            # path; a chaos-dropped hedge is simply not sent (no
+            # bookkeeping — the next tick may try again, the plan's
+            # counters decide)
+            try:
+                if not self._dispatch_wire(target, msg):
+                    continue
+            except zmq.ZMQError:
+                # gone peer (ROUTER_MANDATORY): cull it like the primary
+                # dispatch path does, or this loop re-hedges onto the dead
+                # route every tick until the heartbeat cull
+                self.remove_worker(target)
+                continue
+            entry["hedged"] = target
+            entry["hedged_at"] = now
+            self._mark_hedged(token, now)
+            if target in self.worker_map:
+                self.worker_map[target]["busy"] = True
+                self.worker_map[target]["last_seen"] = now
+            self.counters["hedged_dispatches"] += 1
+            self.flight.record(
+                "hedged_dispatch",
+                token=token, worker=target, original=entry["worker"],
+                age_ms=round((now - entry["sent_at"]) * 1000.0, 1),
+            )
+
+    def _retry_backoff(self, msg, retries):
+        """Exponential backoff + deterministic jitter between dispatch
+        attempts of one shard: base * 2^retries capped, stretched by up to
+        25% keyed on the work token (stable across re-runs, different
+        across shards — simultaneous failovers de-stampede)."""
+        return backoff.backoff_delay(
+            retries,
+            str(msg.get("token") or ""),
+            base=RETRY_BACKOFF_BASE_S,
+            cap=RETRY_BACKOFF_CAP_S,
+        )
+
+    def _requeue(self, entry, charge_retry=True, failed_worker=None,
+                 reason=None):
         msg = entry["msg"]
         retries = entry.get("retries", 0)
-        if charge_retry and retries >= MAX_DISPATCH_RETRIES:
+        if failed_worker is None:
+            failed_worker = entry.get("worker")
+        # per-attempt forensic history rides the message (bounded by the
+        # retry budget); the structured exhaustion envelope surfaces it so
+        # a client sees WHERE its query died instead of timing out blind
+        history = list(msg.get("_attempt_history") or [])
+        history.append(
+            {
+                "worker": failed_worker,
+                "reason": str(
+                    reason or "worker lost or dispatch timed out"
+                )[:200],
+                "retries": retries,
+                "ts": round(time.time(), 3),
+            }
+        )
+        msg["_attempt_history"] = history
+        if failed_worker:
+            # replica failover: the retry must land on a DIFFERENT holder
+            # while one exists (find_free_worker's exclude contract)
+            excluded = list(msg.get("_excluded_workers") or [])
+            if failed_worker not in excluded:
+                excluded.append(failed_worker)
+            msg["_excluded_workers"] = excluded
+        if charge_retry and retries >= self.max_dispatch_retries:
             self._abort_work(
                 msg,
                 f"shard {msg.get('filename')} failed after "
-                f"{retries} retries (worker lost or timed out)",
+                f"{retries} retries (worker lost, timed out, or faulted)",
+                error_class="DispatchExhausted",
+                attempts=history,
             )
             return
+        if charge_retry and failed_worker:
+            self.counters["failover_dispatches"] += 1
         msg["_retries"] = retries + 1 if charge_retry else retries
+        msg["_not_before"] = time.time() + self._retry_backoff(msg, retries)
         # each dispatch ATTEMPT is its own trace hop: a fresh span_id (a
         # slow-but-alive first worker's calc span keeps parenting to the
         # original attempt's recorded span) and a fresh queue-entry clock
@@ -863,6 +1267,8 @@ class ControllerNode:
             msg.set_trace(wire)
             msg["_dispatch_queued_ts"] = time.time()
         affinity = msg.get("affinity")
+        if msg.get("token"):
+            self._requeued_tokens.add(msg.get("token"))
         self.worker_out_messages.setdefault(affinity, []).append(msg)
 
     # -- inbound demux -----------------------------------------------------
@@ -1007,11 +1413,162 @@ class ControllerNode:
         token = msg.get("token")
         if token:
             self.worker_map[worker_id]["busy"] = False
-            self.inflight.pop(token, None)
-            self.process_worker_result(msg)
+            # chaos site controller.reply (shard results only — faulting a
+            # lockstep REQ verb's reply would mis-pair the client socket):
+            # drop simulates a reply lost on the wire, duplicate replays it
+            fault = (
+                chaos.fire(
+                    "controller.reply",
+                    worker=worker_id,
+                    token=token,
+                    verb=msg.get("payload"),
+                    parent=msg.get("parent_token"),
+                )
+                if chaos.enabled() and msg.get("parent_token") else None
+            )
+            if fault is not None and fault.action == "drop":
+                self.flight.record(
+                    "chaos_reply_dropped", token=token, worker=worker_id
+                )
+                return
+            entry = self.inflight.pop(token, None)
+            if entry is None and token in self._hedged_tokens:
+                # hedge loser / outdistanced stale attempt (or a chaos
+                # duplicate of the winner): the token already completed —
+                # first reply won, this one is counted and dropped, never
+                # merged a second time
+                self._hedged_tokens.pop(token, None)
+                self._discard_loser(token, worker_id)  # answered after all
+                self.counters["duplicate_replies"] += 1
+                return
+            if entry is None and token in self._requeued_tokens:
+                # the shard's retry is still parked in the dispatch queue
+                # (backoff window / waiting for a free holder) and a late
+                # reply from the FAILED attempt landed first
+                if msg.isa(ErrorMessage):
+                    # a stale fault is not news — the queued retry stands;
+                    # aborting here would fail the query with a healthy
+                    # replica attempt still pending
+                    self.counters["duplicate_replies"] += 1
+                    self.flight.record(
+                        "stale_reply_dropped",
+                        token=token, worker=worker_id,
+                        error=str(msg.get("payload"))[:200],
+                    )
+                    return
+                # a late VALID result wins: withdraw the queued retry (a
+                # fresh execution would be redundant) and deliver.  Mark
+                # the token in the dedup ring — another superseded attempt
+                # may still be computing it, and its later reply (valid OR
+                # a non-transient error) must be counted and dropped, not
+                # abort the parent the orphan fall-through would reach
+                self._requeued_tokens.discard(token)
+                self._withdraw_queued(token)
+                self._mark_hedged(token, time.time())
+            if entry is not None:
+                assigned = entry.get("worker")
+                hedged = entry.get("hedged")
+                outstanding = [
+                    w for w in (assigned, hedged)
+                    if w is not None and w != worker_id
+                ]
+                if worker_id not in (assigned, hedged):
+                    # late reply from a PREVIOUS attempt's worker: the shard
+                    # was requeued (timeout/fault) and the CURRENT attempt
+                    # is still computing on `outstanding`
+                    if msg.isa(ErrorMessage):
+                        # a stale fault is not news — the live attempt
+                        # stands; reinstate its reclaim handle untouched
+                        self.inflight[token] = entry
+                        self.counters["duplicate_replies"] += 1
+                        self.flight.record(
+                            "stale_reply_dropped",
+                            token=token, worker=worker_id,
+                            error=str(msg.get("payload"))[:200],
+                        )
+                        return
+                    # a late VALID result: first reply wins (replica holders
+                    # compute the identical payload).  Dedup the live
+                    # attempt's eventual reply, and keep reclaim handles on
+                    # every worker still computing it — the popped entry was
+                    # their hard-timeout handle, and without one a wedged
+                    # holder sits busy-and-advertised forever
+                    self._mark_hedged(token, time.time())
+                    self._note_losers(token, outstanding)
+                elif hedged and msg.isa(ErrorMessage):
+                    # one side of a hedged pair failed — transiently or not
+                    # — while the other is still computing and may well
+                    # answer: fail over THIS side only — re-key the inflight
+                    # entry to the survivor.  No requeue (a third execution
+                    # would be redundant while the duplicate lives), no
+                    # retry charge (the attempt continues), and no abort
+                    # even for a permanent error or at the budget's edge —
+                    # the outstanding answer decides; if the survivor also
+                    # errors, its un-hedged entry takes the normal
+                    # requeue/abort path
+                    refiled = self._collapse_hedge(token, entry, worker_id)
+                    transient = bool(msg.get("transient"))
+                    if transient:
+                        self.counters["transient_faults"] += 1
+                    self.flight.record(
+                        "transient_fault" if transient
+                        else "hedge_side_error",
+                        token=token, worker=worker_id,
+                        survivor=refiled["worker"],
+                        error=str(msg.get("payload"))[:200],
+                    )
+                    return
+                elif hedged:
+                    self._mark_hedged(token, time.time())  # loser still due
+                    if worker_id == hedged:
+                        self.counters["hedge_wins"] += 1
+                    # the pop above destroyed the token's inflight entry,
+                    # which was also the hard-timeout reclaim handle on the
+                    # side that has NOT replied yet — keep one, or a wedged
+                    # loser sits busy-and-advertised forever
+                    # (retry_stale_dispatches reclaims it like any other
+                    # hung dispatch)
+                    self._note_losers(token, outstanding)
+            else:
+                # orphaned late reply (its dedup-ring marker may have been
+                # evicted on a busy cluster): still drain any reclaim
+                # handle held on this worker — a loser that answered must
+                # not be hard-timeout removed as 'silent' later
+                if token in self._hedge_losers:
+                    # loser tracking outlives the 256-entry ring and proves
+                    # this token was already answered: count-and-drop like
+                    # the ring branch — a late non-transient ErrorMessage
+                    # here must not abort a parent whose shard is merged
+                    self._discard_loser(token, worker_id)
+                    self.counters["duplicate_replies"] += 1
+                    self.flight.record(
+                        "stale_reply_dropped",
+                        token=token, worker=worker_id,
+                        error=(
+                            str(msg.get("payload"))[:200]
+                            if msg.isa(ErrorMessage) else None
+                        ),
+                    )
+                    return
+                self._discard_loser(token, worker_id)
+            self.process_worker_result(msg, entry)
+            if fault is not None and fault.action == "duplicate":
+                # replay the envelope through the sink: definitionally a
+                # duplicate.  A still-open segment counts it at the
+                # in-segment key dedup; only a COMPLETED segment orphans
+                # the replay before that site, so count it here exactly
+                # when no open segment will (one injected duplicate = one
+                # increment, never two)
+                parent = msg.get("parent_token")
+                subs = self._work_subscribers.get(token) or (
+                    (parent,) if parent is not None else ()
+                )
+                if not any(p in self.rpc_segments for p in subs):
+                    self.counters["duplicate_replies"] += 1
+                self.process_worker_result(msg, None)
 
     # -- results sink ------------------------------------------------------
-    def process_worker_result(self, msg):
+    def process_worker_result(self, msg, entry=None):
         parent = msg.get("parent_token")
         token = msg.get("token")
         subscribers = self._work_subscribers.get(token)
@@ -1022,6 +1579,30 @@ class ControllerNode:
             if data is not None:
                 msg.add_as_binary("result", data)
             self.reply_rpc_message(msg.get("token"), msg)
+            return
+        if msg.isa(ErrorMessage) and msg.get("transient"):
+            # transient (retryable) worker fault — DeviceBusyError class:
+            # fail the shard over to a different holder instead of killing
+            # the query; _requeue excludes the faulted worker and aborts
+            # with the structured envelope only once the budget is spent
+            reason = str(msg.get("payload") or "transient fault")
+            reason = (reason.strip().splitlines() or ["transient fault"])[-1]
+            if entry is not None:
+                self.counters["transient_faults"] += 1
+                self.flight.record(
+                    "transient_fault",
+                    token=token,
+                    worker=entry.get("worker"),
+                    error=reason[:200],
+                )
+                self._requeue(
+                    entry, failed_worker=entry.get("worker"), reason=reason
+                )
+            # entry is None: the shard was already requeued (timeout) or
+            # completed (hedge), or this is a chaos replay — a duplicate of
+            # a fault, not a new one (one real fault = one count)
+            else:
+                self.counters["duplicate_replies"] += 1
             return
         self._drop_work(token)
         parents = list(subscribers) if subscribers else [parent]
@@ -1040,11 +1621,21 @@ class ControllerNode:
         # host-gather baseline the device-resident merge is judged against
         self.counters["reply_payload_bytes"] += len(data)
         delivered = False
+        counted_duplicate = False
         for p in parents:
             segment = self.rpc_segments.get(p)
             if segment is None:
                 continue  # that subscriber aborted earlier
             delivered = True
+            if key in segment["results"] and not counted_duplicate:
+                # token/key dedup backstop (late retry, hedge loser, chaos
+                # duplicate): the payload slot is keyed by shard filename,
+                # so a duplicate overwrites its own identical payload —
+                # counted for visibility (once per ENVELOPE, not per
+                # subscriber of shared work), structurally never
+                # double-merged
+                self.counters["duplicate_replies"] += 1
+                counted_duplicate = True
             segment["results"][key] = data
             segment["timings"][key] = msg.get("phase_timings")
             effective = msg.get("effective_strategy")
@@ -1070,16 +1661,25 @@ class ControllerNode:
         segment = self.rpc_segments.get(parent)
         if segment is None:
             return
-        covered = sum(len(k) for k in segment["results"])
-        if covered < len(segment["filenames"]):
+        # greedy DISJOINT cover, largest keys first: a re-split batch can
+        # leave both the late batch payload and its per-shard children in
+        # results (keys are laminar — a group and/or singletons from its
+        # re-split), and overlapping keys must neither complete the
+        # segment early nor merge a shard's payload twice
+        chosen, covered = [], set()
+        for k in sorted(segment["results"], key=len, reverse=True):
+            files = set(k)
+            if files & covered:
+                continue
+            chosen.append(k)
+            covered |= files
+        if not covered.issuperset(segment["filenames"]):
             return
         self.rpc_segments.pop(parent)
         # payloads in requested-filename order (not reply-arrival order):
         # the aggregate=False rows path concatenates payloads client-side,
         # and the reference's row order is deterministic by filename
-        covering = {
-            f: k for k in segment["results"] for f in k
-        }
+        covering = {f: k for k in chosen for f in k}
         payloads, seen = [], set()
         for f in segment["filenames"]:
             k = covering[f]
@@ -1247,7 +1847,8 @@ class ControllerNode:
         if recorded:
             self.counters["slow_queries"] += 1
 
-    def abort_parent(self, parent, error_text, reply=True):
+    def abort_parent(self, parent, error_text, reply=True, error_class=None,
+                     attempts=None):
         segment = self.rpc_segments.pop(parent, None)
         if segment is None:
             return
@@ -1277,7 +1878,18 @@ class ControllerNode:
             parent,
             segment,
             pickle.dumps(
-                {"ok": False, "error": str(error_text)}, protocol=4
+                {
+                    "ok": False,
+                    "error": str(error_text),
+                    # structured failure detail (messages.py result-envelope
+                    # schema): the error class plus the per-attempt
+                    # worker/fault history the flight recorder accumulated,
+                    # so a retry-exhausted client learns WHERE its query
+                    # died instead of a bare string (or a blind timeout)
+                    "error_class": error_class,
+                    "attempts": list(attempts or []),
+                },
+                protocol=4,
             ) if reply else None,
             error=error_text,
         )
@@ -1500,6 +2112,20 @@ class ControllerNode:
             "counters": dict(self.counters),
             "admission": self.admission.stats(),
             "shard_stats_known": len(self.shard_stats),
+            # replica placement visibility: the configured factor, shards
+            # bucketed by live holder count, and the shards failover can't
+            # yet help (fewer holders than the factor asks for)
+            "replication": {
+                "replica_factor": self.replica_factor,
+                "shards_by_holders": self._holder_counts(),
+                # the shards failover can't yet help: fewer live holders
+                # than the factor asks for (factor 0 = "all nodes" mode,
+                # where a single-holder shard is still the pager signal)
+                "under_replicated": sorted(
+                    f for f, holders in self.files_map.items()
+                    if len(holders) < (self.replica_factor or 2)
+                )[:64],
+            },
             # every worker's latency histograms, merged by bucket-vector
             # addition (identical fixed buckets are the precondition, see
             # obs.metrics) — rides peer gossip too, so any controller can

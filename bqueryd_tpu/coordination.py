@@ -24,7 +24,17 @@ import os
 import threading
 import time
 
-__all__ = ["coordination_store", "CoordinationStore", "StoreLock"]
+__all__ = [
+    "coordination_store", "chaos_store", "CoordinationStore", "StoreLock",
+    "StorePartitioned",
+]
+
+
+class StorePartitioned(OSError):
+    """The coordination store is unreachable (injected by the chaos
+    ``coordination.store`` site's ``partition`` action — the shape a real
+    Redis ConnectionError takes).  Callers already treat store access as
+    fallible: heartbeat ticks skip, loops log and continue."""
 
 
 class StoreLock:
@@ -460,7 +470,7 @@ def coordination_store(url):
     """Construct the right backend for ``url``.  Accepts an existing store
     instance unchanged so tests can inject doubles (the reference's
     subclass-level seam strategy, SURVEY.md §4)."""
-    if isinstance(url, CoordinationStore):
+    if isinstance(url, (CoordinationStore, ChaosStore)):
         return url
     if url.startswith("mem://"):
         return MemoryStore(url)
@@ -469,3 +479,107 @@ def coordination_store(url):
     if url.startswith("redis://") or url.startswith("rediss://"):
         return RedisStore(url)
     raise ValueError(f"unsupported coordination url: {url!r}")
+
+
+# ---------------------------------------------------------------------------
+# chaos seam — coordination.store injection site
+# ---------------------------------------------------------------------------
+
+class ChaosStore:
+    """Delegating wrapper that fires the ``coordination.store`` chaos site
+    before every operation, tagged with this node's id so a fault plan can
+    partition ONE worker from the store while its zmq sockets stay up (the
+    Redis-partition scenario).  The ``partition`` action raises
+    :class:`StorePartitioned`; disarmed, each op pays one None check in
+    ``chaos.fire``.
+
+    Deliberately NOT a :class:`CoordinationStore` subclass: the base class
+    defines every operation (as ``NotImplementedError`` stubs), which would
+    shadow the ``__getattr__`` delegation below."""
+
+    _OPS = (
+        "sadd", "srem", "smembers", "hset", "hget", "hgetall", "hdel",
+        "keys", "delete", "flushdb", "lock",
+    )
+
+    def __init__(self, inner, node_id=None):
+        self._inner = inner
+        self._node_id = node_id
+        self.url = inner.url
+
+    def _guarded(self, op):
+        from bqueryd_tpu import chaos
+
+        if not chaos.enabled():
+            return
+        fault = chaos.fire(
+            "coordination.store", op=op, node=self._node_id
+        )
+        if fault is not None and fault.action == "partition":
+            raise StorePartitioned(
+                f"chaos: coordination store partitioned from "
+                f"{self._node_id or 'node'} (op {op})"
+            )
+
+    def __getattr__(self, name):
+        # only store OPERATIONS are guarded; anything else (url, private
+        # helpers a backend exposes) passes straight through
+        attr = getattr(self._inner, name)
+        if name not in self._OPS:
+            return attr
+
+        def guarded(*args, **kwargs):
+            self._guarded(name)
+            result = attr(*args, **kwargs)
+            if name == "lock":
+                # the factory hands back a StoreLock bound to the INNER
+                # store — wrap it so acquire/extend/release fail during a
+                # partition window too (a real Redis partition kills the
+                # lock operations, not just the factory call)
+                result = _ChaosLock(result, self._guarded)
+            return result
+
+        guarded.__name__ = name
+        return guarded
+
+
+class _ChaosLock:
+    """StoreLock proxy handed out by :class:`ChaosStore`: every lock
+    operation re-fires the ``coordination.store`` site (op ``lock``) so a
+    partitioned node loses its in-flight locks the way a real partition
+    takes them — mid-acquire, mid-extend, mid-release."""
+
+    def __init__(self, inner, guard):
+        self._inner = inner
+        self._guard = guard
+
+    def acquire(self, *args, **kwargs):
+        self._guard("lock")
+        return self._inner.acquire(*args, **kwargs)
+
+    def release(self):
+        self._guard("lock")
+        return self._inner.release()
+
+    def extend(self, additional_time):
+        self._guard("lock")
+        return self._inner.extend(additional_time)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def chaos_store(store, node_id=None):
+    """Wrap ``store`` with the ``coordination.store`` injection seam.
+    Nodes wrap unconditionally — the disarmed cost is one None check per
+    store op, and store ops run at heartbeat cadence, not query cadence."""
+    if isinstance(store, ChaosStore):
+        return store
+    return ChaosStore(store, node_id=node_id)

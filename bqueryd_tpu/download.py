@@ -55,9 +55,32 @@ def slot_state(value):
 # controller side
 # ---------------------------------------------------------------------------
 
+def placement_nodes(filename, nodes, factor):
+    """Replica placement: the ``factor`` nodes that should hold ``filename``,
+    chosen by rendezvous hashing (highest-random-weight) so the choice is
+    deterministic per file, stable under node churn (only 1/n of files move
+    when a node joins/leaves), and balanced across the fleet.  ``factor``
+    <= 0 or >= len(nodes) means every node (the historical full fan-out)."""
+    import zlib
+
+    if factor <= 0 or factor >= len(nodes):
+        return list(nodes)
+    ranked = sorted(
+        nodes,
+        key=lambda node: zlib.crc32(f"{node}\x00{filename}".encode()),
+    )
+    return ranked[:factor]
+
+
 def setup_download(controller, msg):
-    """Register a ticket for every (file, node) pair and either park the RPC
-    until a TicketDoneMessage (wait=True) or return the ticket immediately."""
+    """Register a ticket for every (file, placement-node) pair and either
+    park the RPC until a TicketDoneMessage (wait=True) or return the ticket
+    immediately.
+
+    Placement honors ``BQUERYD_TPU_REPLICA_FACTOR`` (overridable per ticket
+    via ``replica_factor=``): 0 keeps the historical every-node fan-out; N
+    targets N holders per file via rendezvous hashing — how a cold shard
+    gets the second holder the failover dispatch needs."""
     _args, kwargs = msg.get_args_kwargs()
     filenames = kwargs.get("filenames") or []
     bucket = kwargs.get("bucket")
@@ -65,6 +88,13 @@ def setup_download(controller, msg):
     scheme = kwargs.get("scheme", "s3")
     if not filenames or not bucket:
         raise ValueError("download needs filenames=[...] and bucket=...")
+
+    factor = kwargs.get("replica_factor")
+    if factor is None:
+        # the controller ctor is the single parse site for
+        # BQUERYD_TPU_REPLICA_FACTOR (clamped to >= 0); re-reading the env
+        # here would drift from those semantics
+        factor = getattr(controller, "replica_factor", 0)
 
     nodes = sorted(
         {info.get("node") for info in controller.worker_map.values() if info.get("node")}
@@ -77,7 +107,7 @@ def setup_download(controller, msg):
     ticket = os.urandom(8).hex()
     for filename in filenames:
         fileurl = f"{scheme}://{bucket}/{filename}"
-        for node in nodes:
+        for node in placement_nodes(filename, nodes, int(factor)):
             set_progress(controller.store, node, ticket, fileurl, -1)
 
     if wait:

@@ -103,6 +103,10 @@ ENVELOPE_SCHEMA = {
                   "collective, final table only fetched), 'host' "
                   "(hostmerge.merge_payloads fallback), 'none' (single "
                   "payload, nothing merged)",
+    "transient": "on worker ErrorMessage replies: the failure is retryable "
+                 "(chaos.TransientError class, e.g. DeviceBusyError) — the "
+                 "controller fails the shard over to a different holder "
+                 "instead of aborting the query",
     "error": "failure detail on error/ticketdone paths",
     "result": "base64-pickled rpc verb return value",
     # worker register messages (WRM heartbeats)
@@ -130,6 +134,14 @@ ENVELOPE_SCHEMA = {
     "busy": "controller-local: worker has work in flight",
     "hb_only": "controller-local: worker seen only via heartbeats so far",
     "_retries": "controller-internal: dispatch retry count rider",
+    "_excluded_workers": "controller-internal: holders this shard already "
+                         "failed on — failover dispatch avoids them while "
+                         "another candidate exists",
+    "_attempt_history": "controller-internal: per-attempt worker/fault "
+                        "records, surfaced in the structured exhaustion "
+                        "envelope (attempts key)",
+    "_not_before": "controller-internal: failover backoff gate — the "
+                   "dispatcher holds the shard until this timestamp",
     "_dispatch_queued_ts": "controller-internal: dispatch queue-entry time",
     "_relayed": "controller-internal: fan-out marker on relayed verbs",
     "_obs": "controller-internal: per-query observability state rider",
@@ -147,6 +159,12 @@ RESULT_ENVELOPE_SCHEMA = {
     "merge_modes": "shard-group -> merge_mode the worker reported "
                    "(device/host/none; see the merge_mode envelope key)",
     "error": "failure reason when ok is False",
+    "error_class": "structured failure class when ok is False (e.g. "
+                   "'DispatchExhausted' once the retry/failover budget is "
+                   "spent); None for plain errors",
+    "attempts": "per-attempt worker/fault history ({worker, reason, "
+                "retries, ts} dicts) behind an error_class failure — the "
+                "flight-recorder trail a client can act on",
 }
 
 #: keys legitimately touched on only one side of the wire MODULES — the peer

@@ -488,8 +488,20 @@ class MeshQueryExecutor:
         """``strategy`` is the planner's kernel-route hint, threaded into the
         mesh program's ``partial_tables`` call (and its trace cache key);
         None/"auto" keeps the dispatcher's own adaptive choice."""
-        from bqueryd_tpu import ops
+        from bqueryd_tpu import chaos, ops
 
+        # chaos site worker.device: a transient DeviceBusyError raised here
+        # rides the same recovery seam as a real flaky tunneled backend —
+        # the worker's handler marks the ErrorMessage transient and the
+        # controller fails the shard over to a replica holder.  The
+        # enabled() pre-check keeps the disarmed hot path from paying the
+        # signature stringification just to hand fire() a discarded ctx
+        if chaos.enabled():
+            chaos.fire(
+                "worker.device",
+                n_tables=len(tables),
+                signature=str(query.signature())[:120],
+            )
         self.last_effective_strategy = None  # set at the kernel dispatch
         self.last_merge_mode = None          # set once the mode resolves
         if strategy in (None, "auto", "host"):

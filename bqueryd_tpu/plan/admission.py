@@ -23,8 +23,9 @@ Control-plane module: stdlib only.
 
 import heapq
 import itertools
-import os
 import time
+
+from bqueryd_tpu.utils.env import env_num
 
 ADMIT = "admit"
 QUEUED = "queued"
@@ -37,11 +38,7 @@ DUPLICATE = "duplicate"
 
 
 def _env_int(name, default):
-    try:
-        # bqtpu: allow[config-dynamic-env-key] callers pass the three literal BQUERYD_TPU_ADMIT_* names below; all in ENV_REGISTRY
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+    return env_num(name, default, cast=int)
 
 
 class AdmissionController:

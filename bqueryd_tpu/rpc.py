@@ -28,6 +28,7 @@ import time
 import zmq
 
 import bqueryd_tpu
+from bqueryd_tpu import backoff, chaos
 from bqueryd_tpu.coordination import coordination_store
 from bqueryd_tpu.messages import ErrorMessage, RPCMessage, msg_factory
 
@@ -42,6 +43,14 @@ class RPCBusyError(RPCError):
 
 
 class RPC:
+    #: capped exponential backoff between retry attempts (timeouts, zmq
+    #: errors, BUSY backpressure): base * 2^attempt, capped, stretched by a
+    #: deterministic per-socket jitter so a thundering herd of retrying
+    #: clients de-synchronizes the same way on every run (shared formula:
+    #: bqueryd_tpu.backoff — the controller's failover pacing uses it too)
+    BACKOFF_BASE_S = backoff.BACKOFF_BASE_S
+    BACKOFF_CAP_S = backoff.BACKOFF_CAP_S
+
     def __init__(
         self,
         address=None,
@@ -55,6 +64,7 @@ class RPC:
     ):
         bqueryd_tpu.configure_logging(loglevel)
         self.logger = bqueryd_tpu.logger.getChild("rpc")
+        chaos.maybe_arm_from_env()
         self.timeout = timeout
         self.retries = retries
         self.legacy_merge = legacy_merge
@@ -63,6 +73,10 @@ class RPC:
         # unset, each socket identity is its own bucket
         self.client_id = client_id
         self.last_call_duration = None
+        #: attempts the most recent call consumed (1 = first try answered;
+        #: >1 means timeouts/reconnects/BUSY backoff were absorbed) — the
+        #: companion to last_call_duration when diagnosing tail latency
+        self.last_call_attempts = None
         #: trace id of the most recent call — feed it to ``rpc.trace(...)``
         #: to pull the controller's per-phase waterfall for that query
         self.last_trace_id = None
@@ -162,32 +176,83 @@ class RPC:
         self.last_trace_id = ctx.trace_id
         msg.set_args_kwargs(list(args), kwargs)
         wire = msg.to_json().encode()
-        reply = None
         last_error = None
-        for attempt in range(self.retries):
+        for attempt in range(1, self.retries + 1):
+            self.last_call_attempts = attempt
             try:
                 if self.socket is None:
                     self.connect()
+                # chaos site rpc.call: "timeout" discards the reply window
+                # (the retry/backoff path must recover), "disconnect"
+                # forces a reconnect storm, "delay" stretches the call
+                fault = chaos.fire(
+                    "rpc.call", verb=name, attempt=attempt,
+                ) if chaos.enabled() else None
+                if fault is not None and fault.action == "disconnect":
+                    self._close_socket()
+                    raise zmq.ZMQError(zmq.ENOTCONN, "chaos: disconnected")
                 self.socket.send(wire)
-                if self.socket.poll(int(self.timeout * 1000), zmq.POLLIN):
+                timed_out = not self.socket.poll(
+                    int(self.timeout * 1000), zmq.POLLIN
+                )
+                if fault is not None and fault.action == "timeout":
+                    timed_out = True  # pretend the reply never arrived
+                if not timed_out:
                     reply = self.socket.recv()
-                    break
+                    try:
+                        result = self._parse_reply(name, reply)
+                    except RPCBusyError:
+                        # deliberate admission backpressure: retry with
+                        # capped exponential backoff inside the attempt
+                        # budget (the REQ send/recv cycle completed, so no
+                        # reconnect is needed; an identical resend joins
+                        # the original run if it got admitted meanwhile)
+                        if attempt >= self.retries:
+                            raise
+                        last_error = "BUSY backpressure"
+                        self.logger.info(
+                            "rpc %s attempt %d got BUSY, backing off",
+                            name, attempt,
+                        )
+                        time.sleep(self._backoff_delay(attempt))
+                        continue
+                    self.last_call_duration = time.perf_counter() - started
+                    return result
                 last_error = f"timeout after {self.timeout}s"
             except zmq.ZMQError as exc:
                 last_error = str(exc)
+            if attempt >= self.retries:
+                # the REQ socket is mid send/recv cycle (send done, reply
+                # never read) — drop it so the NEXT call reconnects cleanly
+                # instead of hitting EFSM on a poisoned socket
+                self._close_socket()
+                break
             self.logger.warning(
-                "rpc %s attempt %d failed (%s), reconnecting",
-                name, attempt + 1, last_error,
+                "rpc %s attempt %d failed (%s), backing off + reconnecting",
+                name, attempt, last_error,
             )
+            time.sleep(self._backoff_delay(attempt))
             try:
                 self.connect()
             except RPCError as exc:
                 last_error = str(exc)
-        if reply is None:
-            raise RPCError(f"rpc {name} failed: {last_error}")
-        result = self._parse_reply(name, reply)
         self.last_call_duration = time.perf_counter() - started
-        return result
+        raise RPCError(
+            f"rpc {name} failed after {self.last_call_attempts} attempts: "
+            f"{last_error}"
+        )
+
+    def _backoff_delay(self, attempt):
+        """Capped exponential backoff with deterministic jitter: base *
+        2^(attempt-1) up to the cap, stretched by up to 25% keyed on this
+        socket's identity + attempt — stable across re-runs (chaos scenarios
+        replay bit-for-bit), distinct across clients (no thundering herd)."""
+        return backoff.backoff_delay(
+            attempt - 1,
+            f"{self.identity}:{attempt}",
+            base=self.BACKOFF_BASE_S,
+            cap=self.BACKOFF_CAP_S,
+        )
 
     def _parse_reply(self, name, reply):
         if name == "groupby":
@@ -211,7 +276,24 @@ class RPC:
         if not envelope.get("ok"):
             if envelope.get("busy"):
                 raise RPCBusyError(envelope.get("error"))
-            raise RPCError(envelope.get("error"))
+            # structured failure envelope (messages.py result schema): the
+            # error class + per-attempt worker/fault history replace the
+            # blind client timeout the exhaustion path used to produce
+            error_class = envelope.get("error_class")
+            attempts = envelope.get("attempts") or []
+            text = str(envelope.get("error"))
+            if error_class:
+                trail = "; ".join(
+                    f"{a.get('worker')}: {a.get('reason')}"
+                    for a in attempts if isinstance(a, dict)
+                )
+                text = f"{error_class}: {text}"
+                if trail:
+                    text = f"{text} [attempts: {trail}]"
+            err = RPCError(text)
+            err.error_class = error_class
+            err.attempts = attempts
+            raise err
         payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
         self.last_call_timings = envelope.get("timings")
         self.last_call_strategies = envelope.get("strategies")

@@ -1,0 +1,23 @@
+"""Shared env-override parsing for registered ``BQUERYD_TPU_*`` knobs.
+
+One parse site (and one lint pragma) instead of a per-module copy: an
+unset, empty, or unparseable override always falls back to the caller's
+default — a typo'd value must degrade to the shipped constant, never take
+a node down at construction time.  Stdlib-only and import-light: the
+jax-free controller reads its timing knobs through here.
+"""
+
+import os
+
+
+def env_num(name, default, cast=float):
+    """The registered override when set and parseable, ``default``
+    otherwise."""
+    # bqtpu: allow[config-dynamic-env-key] callers pass literal registered names: the controller timing knobs (DEAD_WORKER/DISPATCH/DISPATCH_HARD TIMEOUTs, MAX_DISPATCH_RETRIES, HEDGE_MS, REPLICA_FACTOR) and plan.admission's ADMIT_* trio; all in ENV_REGISTRY
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return cast(raw)
+    except (ValueError, TypeError):
+        return default

@@ -38,8 +38,8 @@ import zmq
 from bqueryd_tpu.utils import devicehealth
 
 import bqueryd_tpu
-from bqueryd_tpu import messages
-from bqueryd_tpu.coordination import coordination_store
+from bqueryd_tpu import chaos, messages
+from bqueryd_tpu.coordination import chaos_store, coordination_store
 from bqueryd_tpu.messages import (
     BusyMessage,
     DoneMessage,
@@ -64,6 +64,13 @@ SHARD_EXTENSIONS = (".bcolz", ".bcolzs")
 
 class WorkerBase:
     workertype = "worker"
+    #: chaos wedge latch (worker.execute "wedge" action): advertised in WRMs
+    #: like the real device-health latch, and every groupby on this worker
+    #: raises the transient DeviceBusyError so the controller fails the shard
+    #: over to a replica holder.  Class-level default so partially
+    #: constructed workers (tests build bare instances via ``__new__``) still
+    #: answer ``prepare_wrm`` without the latch.
+    _chaos_wedged = False
 
     def __init__(
         self,
@@ -85,8 +92,17 @@ class WorkerBase:
             f"{self.workertype}.{self.worker_id[:6]}"
         )
         self.node_name = socket_mod.gethostname()
-        self.store = coordination_store(
-            coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
+        # fault injection (bqueryd_tpu.chaos): armed only when
+        # BQUERYD_TPU_FAULT_PLAN is set; unarmed sites are one None check.
+        # The store is wrapped so the coordination.store site can partition
+        # THIS worker from Redis while its zmq sockets stay up.
+        chaos.maybe_arm_from_env()
+        self.store = chaos_store(
+            coordination_store(
+                coordination_url or redis_url
+                or bqueryd_tpu.DEFAULT_COORDINATION_URL
+            ),
+            node_id=self.worker_id,
         )
         self.data_dir = data_dir or bqueryd_tpu.DEFAULT_DATA_DIR
         if self.workertype == "calc" and not os.path.isdir(self.data_dir):
@@ -126,6 +142,12 @@ class WorkerBase:
             "bqueryd_tpu_flight_evictions",
             "flight-ring events evicted by the entry/byte bounds (monotonic)",
             fn=lambda: self.flight.evictions,
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_fault_injected_total",
+            "faults injected by the armed chaos plan, process-lifetime "
+            "(0 while BQUERYD_TPU_FAULT_PLAN is unarmed)",
+            fn=chaos.injected_total,
         )
         self._wedge_gen_seen = devicehealth.health_snapshot()[
             "wedge_generation"
@@ -363,7 +385,10 @@ class WorkerBase:
         waiting for a query.  Downloader/move roles never touch the device;
         their reads stay passive so a WRM can never spawn a jax probe thread
         as a side effect.  Instance-overridable (tests wedge ONE worker of an
-        in-process cluster without touching the process-global latch)."""
+        in-process cluster without touching the process-global latch).
+        A chaos ``wedge`` fault latches the same advertisement path."""
+        if self._chaos_wedged:
+            return True
         return devicehealth.backend_wedged(launch=self.workertype == "calc")
 
     def _debug_snapshot(self, flight_limit=32):
@@ -638,6 +663,30 @@ class WorkerBase:
             obs.TraceContext.from_wire(wire)
         ):
             try:
+                # chaos site worker.execute: transient raises (the failover
+                # trigger), wedge latch, die-after-ack (the Busy above WAS
+                # the ack), delay — all before the deadline check so an
+                # injected stall can expire a deadline like a real one
+                fault = chaos.fire(
+                    "worker.execute",
+                    worker=self.worker_id,
+                    verb=msg.get("payload"),
+                    token=msg.get("token"),
+                    filename=str(msg.get("filename")),
+                ) if chaos.enabled() else None
+                if fault is not None and fault.action == "die_after_ack":
+                    self._chaos_die()
+                    return  # hard crash: no reply, no Done, no goodbye
+                if fault is not None and fault.action == "wedge":
+                    self._chaos_wedged = True
+                    self.flight.record("chaos_wedged")
+                    self.logger.warning(
+                        "chaos: wedge latched — advertising backend_wedged"
+                    )
+                if self._chaos_wedged and msg.isa("groupby"):
+                    raise chaos.DeviceBusyError(
+                        "chaos: accelerator backend wedged"
+                    )
                 if msg.deadline_expired():
                     # the client's budget is already gone: burning kernel
                     # time on an answer nobody is waiting for starves
@@ -660,8 +709,14 @@ class WorkerBase:
                     trace_id=log_fields["trace_id"],
                     error=f"{type(exc).__name__}: {exc}"[:300],
                 )
-                result = ErrorMessage(msg)
-                result["payload"] = traceback.format_exc()
+                err = ErrorMessage(msg)
+                err["payload"] = traceback.format_exc()
+                if isinstance(exc, chaos.TransientError):
+                    # retryable class (DeviceBusyError & co): the controller
+                    # fails the shard over to a different holder instead of
+                    # aborting the parent query (messages.py `transient`)
+                    err["transient"] = True
+                result = err
             else:
                 if obs.enabled():
                     self.flight.record(
@@ -671,6 +726,21 @@ class WorkerBase:
                         trace_id=log_fields["trace_id"],
                         wall_s=round(time.perf_counter() - work_clock, 6),
                     )
+        if result is not None:
+            # chaos site worker.reply: drop loses the finished result on
+            # the wire (dispatch timeout + failover must recover), delay
+            # stretches reply latency (hedging territory)
+            fault = chaos.fire(
+                "worker.reply",
+                worker=self.worker_id,
+                verb=msg.get("payload"),
+                token=msg.get("token"),
+            ) if chaos.enabled() else None
+            if fault is not None and fault.action == "drop":
+                self.flight.record(
+                    "chaos_reply_dropped", token=msg.get("token")
+                )
+                result = None
         if result is not None:
             try:
                 self.send(sender, result)
@@ -689,6 +759,21 @@ class WorkerBase:
             self._last_gc = now
             gc.collect()
         self._check_mem()
+
+    def _chaos_die(self):
+        """die_after_ack: simulate a hard crash after accepting work — the
+        Busy ack went out, then silence.  No reply, no Done, no StopMessage
+        goodbye, heartbeats stop; the controller must recover through its
+        dispatch timeout / dead-worker cull + replica failover.  The loop
+        thread still runs its own socket teardown on exit (zmq sockets are
+        single-thread-only)."""
+        self.logger.warning(
+            "chaos: die_after_ack fired — simulating hard worker crash"
+        )
+        self.flight.record("chaos_die_after_ack")
+        self._hb_stop.set()
+        self.send = lambda *a, **k: None  # silent: no replies, no goodbye
+        self.running = False
 
     def handle_work(self, msg):
         # base verbs shared by every role

@@ -50,6 +50,18 @@ def wait_until(predicate, timeout=30.0, interval=0.05, desc="condition"):
 
 
 @pytest.fixture(autouse=True)
+def _disarmed_chaos():
+    """Disarm fault injection and zero its counters between tests: an armed
+    plan (or injected-fault stats) leaking out of one test must not fire
+    inside another's cluster."""
+    import sys
+
+    yield
+    if "bqueryd_tpu.chaos" in sys.modules:
+        sys.modules["bqueryd_tpu.chaos"]._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_calibration_store():
     """Reset the process-global measured-cost calibration store between
     tests: samples recorded by one test's executor runs must not tilt a

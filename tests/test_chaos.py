@@ -1,0 +1,332 @@
+"""Fault-plan semantics: the determinism contract the chaos bench leans on.
+
+The cluster-level scenarios (die-after-ack failover, reply dedup, redis
+partition) live in tests/test_cluster_resilience.py; these tests pin the
+plan model itself — parsing, matching, trigger bookkeeping, seeded
+determinism, the disarmed no-op path — plus the RPC backoff math and the
+coordination-store partition seam.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from bqueryd_tpu import chaos
+from bqueryd_tpu.chaos import plan as chaos_plan
+
+
+def _plan(*faults, seed=0):
+    return {"seed": seed, "faults": list(faults)}
+
+
+# -- arming & parsing --------------------------------------------------------
+
+def test_disarmed_fire_is_none_and_free():
+    chaos._reset_for_tests()
+    assert chaos.enabled() is False
+    assert chaos.fire("worker.execute", verb="groupby") is None
+    assert chaos.injected_total() == 0
+
+
+def test_arm_from_dict_inline_json_and_path(tmp_path):
+    spec = _plan({"site": "worker.execute", "action": "delay",
+                  "args": {"seconds": 0}})
+    for form in (
+        spec,
+        json.dumps(spec),
+        str(tmp_path / "plan.json"),
+    ):
+        if isinstance(form, str) and not form.startswith("{"):
+            (tmp_path / "plan.json").write_text(json.dumps(spec))
+        plan = chaos.arm(form)
+        assert isinstance(plan, chaos.FaultPlan)
+        assert chaos.enabled()
+        chaos.disarm()
+    assert not chaos.enabled()
+
+
+def test_maybe_arm_from_env(monkeypatch):
+    spec = json.dumps(_plan(
+        {"site": "rpc.call", "action": "delay", "args": {"seconds": 0}}
+    ))
+    monkeypatch.setenv("BQUERYD_TPU_FAULT_PLAN", spec)
+    assert chaos.maybe_arm_from_env() is not None
+    assert chaos.enabled()
+    # unset leaves the armed plan alone (bench arms programmatically and
+    # then constructs nodes, each of which calls maybe_arm_from_env)
+    monkeypatch.delenv("BQUERYD_TPU_FAULT_PLAN")
+    assert chaos.maybe_arm_from_env() is not None
+    assert chaos.enabled()
+
+
+@pytest.mark.parametrize("bad", [
+    "not json {",
+    {"faults": []},
+    {"faults": "nope"},
+    {"seed": 1},
+    {"faults": [{"site": "no.such.site", "action": "delay"}]},
+    {"faults": [{"site": "worker.execute", "action": "partition"}]},
+    # 'raise' is interpreted by fire() but only LEGAL where the seam
+    # catches it — at controller.dispatch it would lose the popped
+    # message (never inflight, never requeued) instead of injecting
+    {"faults": [{"site": "controller.dispatch", "action": "raise"}]},
+    {"faults": [{"site": "controller.reply", "action": "raise"}]},
+    {"faults": [{"site": "rpc.call", "action": "raise"}]},
+    {"faults": [{"site": "coordination.store", "action": "raise"}]},
+    {"faults": [{"site": "worker.execute", "action": "raise",
+                 "banana": 1}]},
+    {"faults": [{"site": "worker.execute"}]},
+    {"typo_top_level": 1, "faults": [
+        {"site": "worker.execute", "action": "raise"}]},
+])
+def test_malformed_plans_fail_loudly_at_arm_time(bad):
+    with pytest.raises(chaos.FaultPlanError):
+        chaos.arm(bad)
+    # a missing plan file must not silently inject nothing either
+    with pytest.raises(chaos.FaultPlanError):
+        chaos.arm("/nonexistent/fault_plan.json")
+
+
+# -- trigger semantics -------------------------------------------------------
+
+def test_match_fnmatch_strings_and_equality():
+    chaos.arm(_plan({
+        "site": "worker.execute", "action": "wedge",
+        "match": {"verb": "group*", "attempt": 2},
+    }))
+    assert chaos.fire("worker.execute", verb="groupby", attempt=1) is None
+    assert chaos.fire("worker.execute", verb="sleep", attempt=2) is None
+    # missing context key = no match (never a crash)
+    assert chaos.fire("worker.execute", attempt=2) is None
+    fault = chaos.fire("worker.execute", verb="groupby", attempt=2)
+    assert fault is not None and fault.action == "wedge"
+
+
+def test_times_after_every_counters():
+    chaos.arm(_plan({
+        "site": "controller.dispatch", "action": "drop",
+        "after": 1, "every": 2, "times": 2,
+    }))
+    fired = [
+        chaos.fire("controller.dispatch") is not None for _ in range(8)
+    ]
+    # skip 1, then every 2nd match, at most 2 fires
+    assert fired == [False, True, False, True, False, False, False, False]
+
+
+def test_seeded_probability_is_deterministic():
+    def run(seed):
+        chaos.arm(_plan(
+            {"site": "rpc.call", "action": "timeout", "probability": 0.5},
+            seed=seed,
+        ))
+        return tuple(
+            chaos.fire("rpc.call") is not None for _ in range(32)
+        )
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must replay the same decisions"
+    assert any(a) and not all(a), "p=0.5 over 32 draws should mix"
+    assert run(8) != a, "a different seed should decide differently"
+
+
+def test_window_semantics_open_fire_exhaust():
+    chaos.arm(_plan({
+        "site": "coordination.store", "action": "partition",
+        "window_s": 0.15,
+    }))
+    assert chaos.fire("coordination.store", op="smembers") is not None
+    assert chaos.fire("coordination.store", op="sadd") is not None
+    time.sleep(0.2)
+    # window closed: exhausted for good, not re-opened
+    assert chaos.fire("coordination.store", op="smembers") is None
+    assert chaos.fire("coordination.store", op="smembers") is None
+
+
+def test_window_honors_times_every_and_probability():
+    """times/every/probability gate matches INSIDE an open window too — a
+    windowed rule armed at 10% must not silently inject at 100%."""
+    chaos.arm(_plan({
+        "site": "coordination.store", "action": "partition",
+        "window_s": 30.0, "times": 2,
+    }))
+    fired = [
+        chaos.fire("coordination.store", op="smembers") is not None
+        for _ in range(6)
+    ]
+    assert fired == [True, True, False, False, False, False]
+
+    chaos.arm(_plan({
+        "site": "coordination.store", "action": "partition",
+        "window_s": 30.0, "every": 3,
+    }))
+    fired = [
+        chaos.fire("coordination.store", op="smembers") is not None
+        for _ in range(7)
+    ]
+    assert fired == [True, False, False, True, False, False, True]
+
+    # probability inside the window: deterministic per seed, not all-fire
+    def run(seed):
+        chaos.arm(_plan(
+            {"site": "coordination.store", "action": "partition",
+             "window_s": 30.0, "probability": 0.5},
+            seed=seed,
+        ))
+        return [
+            chaos.fire("coordination.store", op="smembers") is not None
+            for _ in range(32)
+        ]
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must replay the same windowed decisions"
+    assert any(a) and not all(a), "p=0.5 over 32 in-window draws should mix"
+
+
+def test_site_patterns_and_first_match_wins():
+    chaos.arm(_plan(
+        {"site": "worker.*", "action": "delay", "args": {"seconds": 0},
+         "match": {"verb": "sleep"}},
+        {"site": "worker.execute", "action": "wedge"},
+    ))
+    # rule 0 matches (delay, handled inline -> None returned)
+    assert chaos.fire("worker.execute", verb="sleep") is None
+    # rule 0 mismatches, rule 1 fires
+    fault = chaos.fire("worker.execute", verb="groupby")
+    assert fault is not None and fault.action == "wedge"
+
+
+def test_generic_raise_action_and_error_taxonomy():
+    chaos.arm(_plan(
+        {"site": "worker.device", "action": "raise",
+         "args": {"error": "DeviceBusyError", "message": "busy!"}},
+    ))
+    with pytest.raises(chaos.DeviceBusyError, match="busy!"):
+        chaos.fire("worker.device")
+    assert issubclass(chaos.DeviceBusyError, chaos.TransientError)
+    assert not issubclass(chaos.FaultInjected, chaos.TransientError)
+    # unknown error name degrades to the non-transient FaultInjected
+    chaos.arm(_plan(
+        {"site": "worker.device", "action": "raise",
+         "args": {"error": "NoSuchClass"}},
+    ))
+    with pytest.raises(chaos.FaultInjected):
+        chaos.fire("worker.device")
+
+
+def test_stats_count_injected_faults():
+    chaos._reset_for_tests()
+    chaos.arm(_plan(
+        {"site": "worker.execute", "action": "wedge", "times": 2},
+    ))
+    chaos.fire("worker.execute")
+    chaos.fire("worker.execute")
+    chaos.fire("worker.execute")  # exhausted: not counted
+    assert chaos.injected_total() == 2
+    assert chaos.site_stats() == {"worker.execute": 2}
+    assert chaos.plan_stats()[0]["fired"] == 2
+    assert chaos.plan_stats()[0]["matched"] == 3
+    chaos.disarm()
+    # stats survive disarm (the bench reads them after a scenario)
+    assert chaos.injected_total() == 2
+
+
+def test_rule_counters_are_thread_safe():
+    chaos.arm(_plan({
+        "site": "controller.dispatch", "action": "drop", "times": 50,
+    }))
+    hits = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(25):
+            if chaos.fire("controller.dispatch") is not None:
+                hits.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 50, "times cap must hold exactly under contention"
+
+
+# -- the coordination partition seam ----------------------------------------
+
+def test_chaos_store_partitions_one_node(mem_store_url):
+    from bqueryd_tpu.coordination import (
+        StorePartitioned,
+        chaos_store,
+        coordination_store,
+    )
+
+    victim = chaos_store(coordination_store(mem_store_url), node_id="w-a")
+    bystander = chaos_store(coordination_store(mem_store_url), node_id="w-b")
+    victim.sadd("k", "1")  # disarmed: plain delegation
+    assert victim.smembers("k") == {"1"}
+    chaos.arm(_plan({
+        "site": "coordination.store", "action": "partition",
+        "match": {"node": "w-a"}, "window_s": 30,
+    }))
+    with pytest.raises(StorePartitioned):
+        victim.smembers("k")
+    # the partition is PER NODE: the other store keeps working, as does
+    # the victim's zmq plane (nothing here touches sockets)
+    assert bystander.smembers("k") == {"1"}
+    chaos.disarm()
+    assert victim.smembers("k") == {"1"}
+
+
+def test_chaos_store_partitions_inflight_locks(mem_store_url):
+    """The ``lock`` factory hands back a proxy, not a bare StoreLock: a
+    partition window must kill acquire/extend/release on a lock taken
+    BEFORE the window opened — a real Redis partition takes in-flight
+    locks, not just new ``store.lock(...)`` calls."""
+    from bqueryd_tpu.coordination import (
+        StorePartitioned,
+        chaos_store,
+        coordination_store,
+    )
+
+    victim = chaos_store(coordination_store(mem_store_url), node_id="w-a")
+    lock = victim.lock("dl-ticket", ttl=30)
+    assert lock.acquire(blocking=False)  # disarmed: plain delegation
+    chaos.arm(_plan({
+        "site": "coordination.store", "action": "partition",
+        "match": {"node": "w-a"}, "window_s": 30,
+    }))
+    with pytest.raises(StorePartitioned):
+        lock.extend(30)
+    with pytest.raises(StorePartitioned):
+        lock.release()
+    with pytest.raises(StorePartitioned):
+        victim.lock("dl-ticket-2", ttl=30).acquire(blocking=False)
+    chaos.disarm()
+    lock.release()
+
+
+# -- RPC client backoff (satellite) -----------------------------------------
+
+def test_rpc_backoff_delay_grows_caps_and_jitters_deterministically():
+    from bqueryd_tpu.rpc import RPC
+
+    client = RPC.__new__(RPC)  # no sockets: just the backoff math
+    client.identity = "deadbeef00000000"
+    delays = [client._backoff_delay(a) for a in range(1, 10)]
+    # every delay is its exponential base stretched by at most 25% jitter
+    # (jitter varies per attempt, so the raw sequence need not be strictly
+    # monotonic once the cap flattens the base)
+    base = RPC.BACKOFF_BASE_S
+    cap = RPC.BACKOFF_CAP_S
+    for attempt, delay in zip(range(1, 10), delays):
+        expected = min(base * (2 ** (attempt - 1)), cap)
+        assert expected <= delay <= expected * 1.25, (attempt, delay)
+    # deterministic: same identity + attempt -> same delay
+    assert delays == [client._backoff_delay(a) for a in range(1, 10)]
+    # different identity -> different jitter stream (almost surely)
+    other = RPC.__new__(RPC)
+    other.identity = "feedface00000000"
+    assert [other._backoff_delay(a) for a in range(1, 10)] != delays

@@ -821,9 +821,9 @@ def test_concurrent_clients_survive_worker_churn(tmp_path, mem_store_url):
     requeues = []
     real_requeue = controller._requeue
 
-    def counting_requeue(entry, charge_retry=True):
+    def counting_requeue(entry, charge_retry=True, **kw):
         requeues.append(entry.get("retries", 0))
-        return real_requeue(entry, charge_retry=charge_retry)
+        return real_requeue(entry, charge_retry=charge_retry, **kw)
 
     controller._requeue = counting_requeue
 
@@ -927,3 +927,954 @@ def test_concurrent_clients_survive_worker_churn(tmp_path, mem_store_url):
         )
     finally:
         _stop(all_nodes, threads)
+
+
+# ---------------------------------------------------------------------------
+# fault-plan-driven chaos cases (PR 8): the failover paths exercised on
+# purpose through bqueryd_tpu.chaos instead of hand-rolled monkeypatching
+# ---------------------------------------------------------------------------
+
+def _replica_cluster(tmp_path, mem_store_url, df_seed=11, n_workers=2,
+                     dispatch_timeout=1.5, dispatch_hard_timeout=None,
+                     shards=("rep_0.bcolzs", "rep_1.bcolzs")):
+    """Controller + N workers ALL holding the same shard files (replica
+    topology), small timeouts so failover happens in test time."""
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    rng = np.random.default_rng(df_seed)
+    frames = {}
+    for name in shards:
+        df = pd.DataFrame(
+            {
+                "g": rng.integers(0, 4, 300).astype(np.int64),
+                "v": rng.integers(-(2**40), 2**40, 300).astype(np.int64),
+            }
+        )
+        frames[name] = df
+        ctable.fromdataframe(df, str(tmp_path / name))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=1.0,
+        dispatch_timeout=dispatch_timeout,
+        dispatch_hard_timeout=dispatch_hard_timeout,
+    )
+    workers = [
+        WorkerNode(
+            coordination_url=mem_store_url,
+            data_dir=str(tmp_path),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.2,
+            poll_timeout=0.05,
+        )
+        for _ in range(n_workers)
+    ]
+    threads = _start(controller, *workers)
+    wait_until(
+        lambda: all(
+            len(controller.files_map.get(name, ())) >= n_workers
+            for name in shards
+        ),
+        desc="every shard advertised by every worker (replica topology)",
+    )
+    import pandas as pd
+
+    expected = (
+        pd.concat(frames.values()).groupby("g")["v"].sum().to_dict()
+    )
+    return controller, workers, threads, expected, list(shards)
+
+
+def _ask_sum(mem_store_url, shards, timeout=45):
+    from bqueryd_tpu.rpc import RPC
+
+    rpc = RPC(
+        coordination_url=mem_store_url, timeout=timeout,
+        loglevel=logging.WARNING,
+    )
+    df = rpc.groupby(list(shards), ["g"], [["v", "sum", "s"]], [])
+    return rpc, dict(zip(df["g"].tolist(), df["s"].tolist()))
+
+
+def test_die_after_ack_fails_over_to_replica_holder(tmp_path, mem_store_url):
+    """A worker that hard-crashes after accepting work (die_after_ack: Busy
+    sent, then silence — no reply, no heartbeats) must not fail the query:
+    the dispatch timeout re-queues the shard onto the OTHER holder, the
+    result is bit-identical, and the failover counter proves the path ran."""
+    from bqueryd_tpu import chaos
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        chaos.arm({
+            "seed": 1,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected
+        assert controller.counters["failover_dispatches"] >= 1
+        assert chaos.injected_total() >= 1
+        # exactly one worker died; the survivor still serves
+        wait_until(
+            lambda: len(controller.worker_map) == 1,
+            desc="dead worker culled",
+        )
+        chaos.disarm()
+        _, again = _ask_sum(mem_store_url, shards)
+        assert again == expected
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_transient_device_fault_retries_on_other_holder(
+    tmp_path, mem_store_url
+):
+    """A transient DeviceBusyError (wedge action: the worker latches
+    backend_wedged and raises the transient class) triggers failover to the
+    healthy replica holder — the query succeeds, nothing aborts, and the
+    wedged worker is still alive (advertised wedged) afterwards."""
+    from bqueryd_tpu import chaos
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        chaos.arm({
+            "seed": 2,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "wedge",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected
+        assert controller.counters["transient_faults"] >= 1
+        assert controller.counters["failover_dispatches"] >= 1
+        # both workers still registered: a transient fault must not cull
+        assert len(controller.worker_map) == 2
+        wedged = [w for w in workers if w._chaos_wedged]
+        assert len(wedged) == 1
+        # the wedge is advertised like the real device-health latch
+        wait_until(
+            lambda: any(
+                controller._worker_wedged.get(w.worker_id)
+                for w in wedged
+            ),
+            desc="wedge advertised in WRMs",
+        )
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_duplicated_reply_is_deduped_by_query_token(tmp_path, mem_store_url):
+    """A reply the chaos plan duplicates at the controller must be counted
+    (duplicate_replies) and not double-merged: sums stay bit-identical."""
+    from bqueryd_tpu import chaos
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        chaos.arm({
+            "seed": 3,
+            "faults": [{
+                "site": "controller.reply",
+                "action": "duplicate",
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected, "duplicated reply must not double-merge"
+        assert controller.counters["duplicate_replies"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_dropped_reply_recovers_via_failover(tmp_path, mem_store_url):
+    """A result lost on the wire (controller.reply drop) is recovered by
+    the dispatch timeout + failover re-queue; the answer stays exact."""
+    from bqueryd_tpu import chaos
+
+    # the dropping worker stays alive and heartbeating, so recovery runs
+    # through the HARD timeout (live-but-silent reclaim) — shrink it
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, dispatch_timeout=1.0,
+        dispatch_hard_timeout=1.0,
+    )
+    try:
+        chaos.arm({
+            "seed": 4,
+            "faults": [{
+                "site": "controller.reply",
+                "action": "drop",
+                "times": 1,
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected
+        assert controller.counters["failover_dispatches"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_redis_partitioned_worker_is_culled_and_inflight_requeued(
+    tmp_path, mem_store_url
+):
+    """The redis-partition scenario: ONE worker loses the coordination
+    store (heartbeats stop — its WRM broadcast path reads the store every
+    tick) while its zmq sockets stay up.  With its event loop also blocked
+    mid-query, the controller must time the dispatch out, re-queue the
+    in-flight shard onto the surviving holder, cull the silent worker, and
+    answer exactly."""
+    import time as time_mod
+
+    from bqueryd_tpu import chaos
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, dispatch_timeout=1.0
+    )
+    victim = workers[0]
+    # pin the first dispatch onto the victim AND block it there long enough
+    # for the partition + dispatch timeout to play out
+    got_work = threading.Event()
+    orig_handle_work = victim.handle_work
+
+    def slow_handle_work(msg):
+        got_work.set()
+        time_mod.sleep(4.0)
+        return orig_handle_work(msg)
+
+    victim.handle_work = slow_handle_work
+    # the other worker must not win the first dispatch: mark it busy until
+    # the victim has the work
+    survivor_id = workers[1].worker_id
+    try:
+        chaos.arm({
+            "seed": 5,
+            "faults": [{
+                "site": "coordination.store",
+                "action": "partition",
+                "match": {"node": victim.worker_id},
+                "window_s": 30.0,
+            }],
+        })
+        wait_until(
+            lambda: controller.worker_map.get(survivor_id) is not None,
+            desc="survivor registered",
+        )
+        controller.worker_map[survivor_id]["busy"] = True
+        result_box = {}
+
+        def ask():
+            _, result_box["got"] = _ask_sum(mem_store_url, shards)
+
+        asker = threading.Thread(target=ask, daemon=True)
+        asker.start()
+        wait_until(got_work.is_set, desc="victim received the dispatch")
+        controller.worker_map[survivor_id]["busy"] = False
+        asker.join(timeout=40)
+        assert not asker.is_alive(), "query never completed after partition"
+        assert result_box["got"] == expected
+        # the partitioned worker was culled (no heartbeats reached the
+        # controller once the store access started raising StorePartitioned)
+        wait_until(
+            lambda: victim.worker_id not in controller.worker_map,
+            timeout=15,
+            desc="partitioned worker culled",
+        )
+        assert controller.counters["failover_dispatches"] >= 1
+        assert chaos.site_stats().get("coordination.store", 0) >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_dispatch_exhaustion_returns_structured_error(
+    tmp_path, mem_store_url
+):
+    """With every holder persistently faulting (transient raises, no
+    replica left to absorb them), the retry budget exhausts and the client
+    gets the STRUCTURED envelope: error_class DispatchExhausted + the
+    per-attempt worker/fault history — not a blind timeout."""
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu import chaos
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC, RPCError
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    df = pd.DataFrame(
+        {"g": np.arange(20) % 4, "v": np.arange(20, dtype=np.int64)}
+    )
+    ctable.fromdataframe(df, str(tmp_path / "x.bcolzs"))
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=10.0,
+        dispatch_timeout=10.0,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url,
+        data_dir=str(tmp_path),
+        loglevel=logging.WARNING,
+        restart_check=False,
+        heartbeat_interval=0.1,
+        poll_timeout=0.05,
+    )
+    threads = _start(controller, worker)
+    try:
+        wait_until(
+            lambda: "x.bcolzs" in controller.files_map, desc="registration"
+        )
+        chaos.arm({
+            "seed": 6,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "raise",
+                "match": {"verb": "groupby"},
+                "args": {"error": "DeviceBusyError"},
+            }],
+        })
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=30,
+            loglevel=logging.WARNING,
+        )
+        with pytest.raises(RPCError) as excinfo:
+            rpc.groupby(["x.bcolzs"], ["g"], [["v", "sum", "s"]], [])
+        err = excinfo.value
+        assert getattr(err, "error_class", None) == "DispatchExhausted"
+        attempts = getattr(err, "attempts", [])
+        assert len(attempts) >= 1
+        assert all(a.get("worker") == worker.worker_id for a in attempts)
+        assert any("DeviceBusyError" in str(a.get("reason")) for a in attempts)
+        assert "DispatchExhausted" in str(err)
+        # the sole holder was retried (never excluded outright) and the
+        # abort is structural, not a client timeout
+        assert controller.counters["transient_faults"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller, worker], threads)
+
+
+def test_hedged_dispatch_first_reply_wins(tmp_path, mem_store_url):
+    """BQUERYD_TPU_HEDGE_MS: a shard stuck on a slow holder past the
+    threshold is duplicated onto the other holder; the fast duplicate's
+    reply answers the query (hedge_wins), the slow original's late reply
+    is deduplicated by token (duplicate_replies), sums stay exact."""
+    import time as time_mod
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.worker import WorkerNode
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, dispatch_timeout=30.0,
+        shards=("hedge_0.bcolzs",),
+    )
+    controller.hedge_ms = 300.0
+    slow = workers[0]
+    orig_handle_work = slow.handle_work
+    slowed = threading.Event()
+
+    def slow_handle_work(msg):
+        if msg.isa("groupby"):
+            slowed.set()
+            time_mod.sleep(2.0)
+        return orig_handle_work(msg)
+
+    slow.handle_work = slow_handle_work
+    fast_id = workers[1].worker_id
+    try:
+        wait_until(
+            lambda: controller.worker_map.get(fast_id) is not None,
+            desc="fast worker registered",
+        )
+        # force the first dispatch onto the slow worker
+        controller.worker_map[fast_id]["busy"] = True
+        result_box = {}
+
+        def ask():
+            _, result_box["got"] = _ask_sum(mem_store_url, shards)
+
+        asker = threading.Thread(target=ask, daemon=True)
+        asker.start()
+        wait_until(slowed.is_set, desc="slow worker holds the shard")
+        controller.worker_map[fast_id]["busy"] = False
+        asker.join(timeout=30)
+        assert not asker.is_alive(), "hedged query never completed"
+        assert result_box["got"] == expected
+        assert controller.counters["hedged_dispatches"] >= 1
+        assert controller.counters["hedge_wins"] >= 1
+        # the slow original eventually replies too: deduped, not re-merged
+        wait_until(
+            lambda: controller.counters["duplicate_replies"] >= 1,
+            desc="late original reply deduplicated",
+        )
+    finally:
+        _stop([controller] + workers, threads)
+
+
+def test_late_reply_from_superseded_worker_wins_and_keeps_reclaim_handle(
+    tmp_path, mem_store_url
+):
+    """A worker hung past the hard timeout is removed and its shard
+    re-queued onto the other holder; its LATE valid reply then wins (replica
+    holders compute identical payloads) — and the controller must keep a
+    hard-timeout reclaim handle on the superseded attempt's worker, which is
+    still computing: without one, a wedged holder sits busy-and-advertised
+    forever with no watchdog."""
+    import time as time_mod
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, dispatch_timeout=0.4,
+        dispatch_hard_timeout=2.0, shards=("late_0.bcolzs",),
+    )
+    first, second = workers
+    started = threading.Event()
+
+    def wrap(worker, delay, evt=None):
+        orig = worker.handle_work
+
+        def wrapped(msg):
+            if msg.isa("groupby"):
+                if evt is not None:
+                    evt.set()
+                time_mod.sleep(delay)
+            return orig(msg)
+
+        worker.handle_work = wrapped
+
+    # first: outlives the 2s hard timeout, replies at 3.5s; second picks up
+    # the failover ~2.1-2.6s in and computes for 3s more — so the first
+    # worker's late reply lands while the second is still mid-computation
+    wrap(first, 3.5, started)
+    wrap(second, 3.0)
+    second_id = second.worker_id
+    try:
+        wait_until(
+            lambda: controller.worker_map.get(second_id) is not None,
+            desc="second worker registered",
+        )
+        # force the first dispatch onto the first worker
+        controller.worker_map[second_id]["busy"] = True
+        result_box = {}
+
+        def ask():
+            _, result_box["got"] = _ask_sum(mem_store_url, shards)
+
+        asker = threading.Thread(target=ask, daemon=True)
+        asker.start()
+        wait_until(started.is_set, desc="first worker holds the shard")
+        controller.worker_map[second_id]["busy"] = False
+        asker.join(timeout=30)
+        assert not asker.is_alive(), "query never completed"
+        assert result_box["got"] == expected
+        # the hard timeout really failed the shard over to the second holder
+        assert controller.counters["failover_dispatches"] >= 1
+        # ...and the first worker's late reply won while the second is still
+        # computing: its reclaim handle must survive the inflight-entry pop
+        assert any(
+            second_id in rec["workers"]
+            for rec in controller._hedge_losers.values()
+        ), "no reclaim handle kept on the superseded attempt's worker"
+        # the handle resolves: the loser answers (deduped by token) or is
+        # reclaimed past the hard cap — either way tracking drains
+        wait_until(
+            lambda: not controller._hedge_losers,
+            desc="superseded attempt deduplicated or reclaimed",
+        )
+    finally:
+        _stop([controller] + workers, threads)
+
+
+def test_requeue_of_hedged_entry_collapses_onto_surviving_duplicate(
+    mem_store_url,
+):
+    """A hedged flight whose original side times out (or is culled) must
+    NOT requeue a third execution — and must not leave the token in the
+    hedge dedup ring, where the surviving duplicate's valid reply would be
+    discarded as a 'duplicate' while the shard is still unanswered.  The
+    inflight entry collapses onto the survivor with a rebased timeout
+    clock and the failed side excluded."""
+    import time
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import CalcMessage, WorkerRegisterMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent", dispatch_timeout=0.01,
+        dispatch_hard_timeout=0.02,
+    )
+    try:
+        for wid in ("wa", "wb"):
+            controller.handle_worker(
+                wid.encode(),
+                WorkerRegisterMessage({
+                    "worker_id": wid, "workertype": "calc",
+                    "data_files": ["s.bcolzs"],
+                }),
+            )
+        msg = CalcMessage({
+            "payload": "groupby", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        now = time.time()
+        controller.inflight["t1"] = {
+            "worker": "wa", "sent_at": now - 60, "msg": msg,
+            "parent": "p1", "retries": 0,
+            "hedged": "wb", "hedged_at": now,
+        }
+        controller._hedged_tokens["t1"] = now
+        controller.retry_stale_dispatches()
+        entry = controller.inflight["t1"]
+        assert entry["worker"] == "wb" and "hedged" not in entry
+        assert entry["sent_at"] == now, "survivor clock rebased to the hedge"
+        assert "t1" not in controller._hedged_tokens, (
+            "dedup ring entry would discard the survivor's valid reply"
+        )
+        assert not any(controller.worker_out_messages.values()), (
+            "redundant third execution queued"
+        )
+        assert msg.get("_excluded_workers") == ["wa"]
+        # the hung-but-heartbeating original was reclaimed like any other
+        # hung dispatch; the survivor's entry was left alone
+        assert "wa" not in controller.worker_map
+        assert controller.inflight["t1"]["worker"] == "wb"
+
+        # cull of the HEDGE side: the original attempt stands alone again
+        msg2 = CalcMessage({
+            "payload": "groupby", "token": "t2", "parent_token": "p2",
+            "filename": "s.bcolzs",
+        })
+        controller.inflight["t2"] = {
+            "worker": "wb", "sent_at": now, "msg": msg2,
+            "parent": "p2", "retries": 0,
+            "hedged": "wc", "hedged_at": now,
+        }
+        controller._hedged_tokens["t2"] = now
+        controller.remove_worker("wc")
+        entry2 = controller.inflight["t2"]
+        assert entry2["worker"] == "wb" and "hedged" not in entry2
+        assert "t2" not in controller._hedged_tokens
+        assert msg2.get("_excluded_workers") == ["wc"]
+    finally:
+        controller.socket.close()
+
+
+def test_stale_replies_while_retry_parked_neither_abort_nor_reexecute(
+    mem_store_url,
+):
+    """While a timed-out shard's retry is still parked in the dispatch
+    queue (backoff window / no free holder), a late reply from the FAILED
+    attempt must not abort the query — the parked retry stands for a
+    stale ERROR — and a late VALID result wins outright, withdrawing the
+    queued retry instead of burning a worker on a finished shard."""
+    import time
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import CalcMessage, ErrorMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        aborted = []
+        controller.abort_parent = (
+            lambda parent, *a, **k: aborted.append(parent)
+        )
+        msg = CalcMessage({
+            "payload": "groupby", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        entry = {
+            "worker": "wa", "sent_at": time.time() - 60, "msg": msg,
+            "parent": "p1", "retries": 0,
+        }
+        controller._requeue(entry, reason="test: dispatch timeout")
+        assert "t1" in controller._requeued_tokens
+        # late NON-transient error from the failed attempt: dropped, the
+        # parked retry stands (the old path aborted the parent here)
+        err = ErrorMessage({
+            "payload": "boom", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        controller.handle_worker(b"wa", err)
+        assert aborted == [], (
+            "stale fault aborted a query with a healthy retry parked"
+        )
+        assert controller.counters["duplicate_replies"] == 1
+        queued = controller.worker_out_messages.get(None, [])
+        assert [m.get("token") for m in queued] == ["t1"]
+        # late VALID result from the failed attempt: delivered (first
+        # reply wins) and the queued retry is withdrawn
+        reply = CalcMessage({
+            "payload": "groupby", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        controller.handle_worker(b"wa", reply)
+        assert aborted == []
+        assert "t1" not in controller._requeued_tokens
+        assert not any(controller.worker_out_messages.values()), (
+            "answered shard left queued for a redundant execution"
+        )
+        # the win leaves a dedup-ring marker: ANOTHER superseded attempt
+        # may still be computing the token, and its later non-transient
+        # error must be counted and dropped — not reach the orphan
+        # fall-through and abort the answered parent
+        assert "t1" in controller._hedged_tokens
+        late_err = ErrorMessage({
+            "payload": "boom", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        dups_before = controller.counters["duplicate_replies"]
+        controller.handle_worker(b"wb", late_err)
+        assert aborted == [], (
+            "late error from a second superseded attempt aborted the "
+            "answered query"
+        )
+        assert controller.counters["duplicate_replies"] == dups_before + 1
+    finally:
+        controller.socket.close()
+
+
+def test_orphan_loser_error_after_ring_eviction_does_not_abort(
+    mem_store_url,
+):
+    """A late NON-transient ErrorMessage from a hedge loser whose
+    dedup-ring marker was evicted by the 256-entry cap must not abort the
+    parent: ``_hedge_losers`` outlives the ring and proves the token was
+    already answered, so the reply is counted and dropped like the ring
+    branch would have."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import ErrorMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        aborted = []
+        controller.abort_parent = (
+            lambda parent, *a, **k: aborted.append(parent)
+        )
+        # token answered long ago: the winning reply noted the loser, then
+        # 256+ newer hedges evicted the ring marker
+        controller._note_losers("t1", ["wa"])
+        assert "t1" not in controller._hedged_tokens
+        err = ErrorMessage({
+            "payload": "shard file vanished", "token": "t1",
+            "parent_token": "p1", "filename": "s.bcolzs",
+        })
+        controller.handle_worker(b"wa", err)
+        assert aborted == [], (
+            "orphan loser error aborted a query whose shard was merged"
+        )
+        assert controller.counters["duplicate_replies"] == 1
+        assert "t1" not in controller._hedge_losers, (
+            "answered loser left holding a hard-timeout reclaim handle"
+        )
+    finally:
+        controller.socket.close()
+
+
+def test_hedged_nontransient_error_defers_to_survivor(mem_store_url):
+    """A NON-transient ErrorMessage from one side of a hedged pair must
+    not abort the query (nor count a hedge win) while the other side is
+    still computing: the inflight entry collapses onto the survivor, whose
+    answer decides."""
+    import time
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import CalcMessage, ErrorMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        aborted = []
+        controller.abort_parent = (
+            lambda parent, *a, **k: aborted.append(parent)
+        )
+        msg = CalcMessage({
+            "payload": "groupby", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        now = time.time()
+        controller.inflight["t1"] = {
+            "worker": "wa", "sent_at": now, "msg": msg, "parent": "p1",
+            "retries": 0, "hedged": "wb", "hedged_at": now,
+        }
+        controller._hedged_tokens["t1"] = now
+        err = ErrorMessage({
+            "payload": "corrupt shard copy", "token": "t1",
+            "parent_token": "p1", "filename": "s.bcolzs",
+        })
+        controller.handle_worker(b"wb", err)
+        assert aborted == [], (
+            "hedge-side permanent error aborted a query whose original "
+            "attempt is healthy and still computing"
+        )
+        entry = controller.inflight["t1"]
+        assert entry["worker"] == "wa" and "hedged" not in entry
+        assert controller.counters["hedge_wins"] == 0, (
+            "an error reply counted as a hedge win"
+        )
+        assert controller.counters["transient_faults"] == 0
+        assert "t1" not in controller._hedged_tokens, (
+            "survivor's valid reply would be deduplicated away"
+        )
+        assert msg.get("_excluded_workers") == ["wb"]
+    finally:
+        controller.socket.close()
+
+
+def test_segment_completion_tolerates_overlapping_batch_and_children(
+    mem_store_url,
+):
+    """A re-split batch can leave BOTH the late batch payload and its
+    per-shard children in a segment's results: overlapping keys must
+    neither complete the segment early (sum-of-key-lengths said 4/4 with
+    half the files uncovered) nor merge a shard's payload twice."""
+    import pickle
+
+    from bqueryd_tpu.controller import ControllerNode
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        replies = []
+        controller.reply_rpc_raw = (
+            lambda tok, data: replies.append((tok, data))
+        )
+        controller._finalize_query_obs = lambda *a, **k: None
+        segment = {
+            "client_token": "c1",
+            "filenames": ["f1", "f2", "f3", "f4"],
+            # children (f1,) (f2,) answered, then the original batch's
+            # late valid reply was delivered too
+            "results": {
+                ("f1",): b"c1", ("f2",): b"c2", ("f1", "f2"): b"b12",
+            },
+            "timings": {},
+            "admission_ticket": None,
+            "pruned": [],
+            "obs": None,
+            "strategies": {},
+            "effective": {},
+        }
+        controller.rpc_segments["p1"] = segment
+        controller._maybe_complete_segment("p1")
+        assert "p1" in controller.rpc_segments and not replies, (
+            "overlapping keys double-counted into premature completion"
+        )
+        segment["results"][("f3", "f4")] = b"b34"
+        controller._maybe_complete_segment("p1")
+        assert "p1" not in controller.rpc_segments and replies
+        payloads = pickle.loads(replies[0][1])["payloads"]
+        assert payloads == [b"b12", b"b34"], (
+            "per-shard children merged alongside their own batch payload"
+        )
+    finally:
+        controller.socket.close()
+
+
+def test_maybe_hedge_skips_entries_requeued_mid_loop(mem_store_url):
+    """Culling a gone hedge target mid-loop requeues that worker's OTHER
+    inflight entries: the stale snapshot items must be skipped, not
+    hedged — a ring marker for a parked token would discard the retry's
+    valid reply as a duplicate and burn a redundant execution."""
+    import time
+
+    import zmq as zmq_mod
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import CalcMessage, WorkerRegisterMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        controller.hedge_ms = 1.0
+        for wid in ("wa", "wx", "wb"):
+            controller.handle_worker(
+                wid.encode(),
+                WorkerRegisterMessage({
+                    "worker_id": wid, "workertype": "calc",
+                    "data_files": ["s.bcolzs"],
+                }),
+            )
+        now = time.time()
+        for token, worker in (("t1", "wa"), ("t2", "wx")):
+            m = CalcMessage({
+                "payload": "groupby", "token": token,
+                "parent_token": f"p-{token}", "filename": "s.bcolzs",
+            })
+            controller.inflight[token] = {
+                "worker": worker, "sent_at": now - 60, "msg": m,
+                "parent": f"p-{token}", "retries": 0,
+            }
+        picks = iter(["wx", "wb"])
+        controller.find_free_worker = (
+            lambda *a, **k: next(picks)
+        )
+
+        def dead_route(target, msg):
+            raise zmq_mod.ZMQError()
+
+        controller._dispatch_wire = dead_route
+        controller.maybe_hedge()
+        # hedging t1 onto gone wx culled wx, requeueing t2 mid-loop: the
+        # snapshot item for t2 must be skipped, not hedged
+        assert "t2" in controller._requeued_tokens
+        assert "t2" not in controller._hedged_tokens, (
+            "parked token marked in the dedup ring — its retry's valid "
+            "reply would be discarded as a duplicate"
+        )
+        assert controller.counters["hedged_dispatches"] == 0
+    finally:
+        controller.socket.close()
+
+
+def test_replayed_transient_error_counts_once(mem_store_url):
+    """A chaos-duplicated transient ErrorMessage must count ONE
+    transient_fault: the replay enters process_worker_result with no
+    inflight entry and is a duplicate of the fault, not a new one."""
+    import time
+
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.messages import CalcMessage, ErrorMessage
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir="/nonexistent",
+    )
+    try:
+        msg = CalcMessage({
+            "payload": "groupby", "token": "t1", "parent_token": "p1",
+            "filename": "s.bcolzs",
+        })
+        entry = {
+            "worker": "wa", "sent_at": time.time(), "msg": msg,
+            "parent": "p1", "retries": 0,
+        }
+        err = ErrorMessage({
+            "payload": "DeviceBusyError: chaos", "token": "t1",
+            "parent_token": "p1", "filename": "s.bcolzs",
+            "transient": True,
+        })
+        controller.process_worker_result(err, entry)   # the real fault
+        controller.process_worker_result(err, None)    # the chaos replay
+        assert controller.counters["transient_faults"] == 1, (
+            "one injected duplicate inflated the transient-fault rate"
+        )
+        assert controller.counters["duplicate_replies"] == 1
+    finally:
+        controller.socket.close()
+
+
+def test_hedged_transient_fault_defers_to_outstanding_duplicate(
+    tmp_path, mem_store_url
+):
+    """A transient fault from one side of a hedged pair must NOT requeue or
+    abort the shard while the duplicate is still computing: the inflight
+    entry is re-keyed to the survivor, whose reply answers the query — no
+    redundant third execution (failover_dispatches stays 0) and no
+    DispatchExhausted abort with a correct answer in flight."""
+    from bqueryd_tpu import chaos as chaos_mod
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, dispatch_timeout=30.0,
+        shards=("hedtr_0.bcolzs",),
+    )
+    controller.hedge_ms = 200.0
+    faulty, steady = workers
+    faulty_started = threading.Event()
+    fault_now = threading.Event()
+    steady_go = threading.Event()
+
+    orig_faulty = faulty.handle_work
+
+    def faulty_work(msg):
+        if msg.isa("groupby"):
+            faulty_started.set()
+            fault_now.wait(timeout=20)
+            raise chaos_mod.DeviceBusyError("injected: hedged-pair fault")
+        return orig_faulty(msg)
+
+    faulty.handle_work = faulty_work
+    orig_steady = steady.handle_work
+
+    def steady_work(msg):
+        if msg.isa("groupby"):
+            steady_go.wait(timeout=20)
+        return orig_steady(msg)
+
+    steady.handle_work = steady_work
+    steady_id = steady.worker_id
+    try:
+        wait_until(
+            lambda: controller.worker_map.get(steady_id) is not None,
+            desc="steady worker registered",
+        )
+        # force the first dispatch onto the faulty worker
+        controller.worker_map[steady_id]["busy"] = True
+        result_box = {}
+
+        def ask():
+            _, result_box["got"] = _ask_sum(mem_store_url, shards)
+
+        asker = threading.Thread(target=ask, daemon=True)
+        asker.start()
+        wait_until(faulty_started.is_set, desc="faulty worker holds the shard")
+        controller.worker_map[steady_id]["busy"] = False
+        wait_until(
+            lambda: controller.counters["hedged_dispatches"] >= 1,
+            desc="tail shard hedged onto the steady holder",
+        )
+        fault_now.set()
+        wait_until(
+            lambda: controller.counters["transient_faults"] >= 1,
+            desc="transient fault from the hedged pair processed",
+        )
+        # no requeue happened: the entry now rides the surviving duplicate
+        assert controller.counters["failover_dispatches"] == 0
+        assert [
+            e["worker"] for e in controller.inflight.values()
+        ] == [steady_id]
+        steady_go.set()
+        asker.join(timeout=30)
+        assert not asker.is_alive(), "query never completed"
+        assert result_box["got"] == expected
+        assert controller.counters["failover_dispatches"] == 0
+        assert not controller.inflight
+    finally:
+        _stop([controller] + workers, threads)

@@ -534,7 +534,13 @@ def test_worker_histograms_aggregate_into_info(obs_cluster):
     def aggregated():
         info = obs_cluster["controller"].get_info()
         hists = info.get("worker_histograms", {})
-        return hists.get("bqueryd_tpu_worker_groupby_seconds")
+        series = hists.get("bqueryd_tpu_worker_groupby_seconds")
+        # snapshots ride periodic WRMs, and a pre-groupby WRM legitimately
+        # carries the family with all-zero counts — wait for the heartbeat
+        # that reflects the observation, not just for the family to exist
+        if not series or sum(sum(e["counts"]) for e in series) < 1:
+            return None
+        return series
 
     series = wait_until(aggregated, desc="worker histogram snapshot in WRM")
     total = sum(sum(e["counts"]) for e in series)
