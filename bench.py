@@ -562,6 +562,98 @@ def _chaos_scenario_plans(workers):
     }
 
 
+def _conc_swarm(url, queries_by_client, window_ms):
+    """Closed-loop multi-client swarm against a live controller: one thread
+    (one REQ socket) per client, a per-round barrier so every round's
+    queries land concurrently (the serving pattern the admission window
+    exists for), ``window_ms`` pinned for the leg.  Returns
+    ``(results[(client, round)], per-query walls, elapsed_s)``."""
+    from bqueryd_tpu.rpc import RPC
+
+    n_clients = len(queries_by_client)
+    barrier = threading.Barrier(n_clients)
+    results = {}
+    walls = []
+    lock = threading.Lock()
+    errors = []
+    prior = os.environ.get("BQUERYD_TPU_BATCH_WINDOW_MS")
+    if window_ms:
+        os.environ["BQUERYD_TPU_BATCH_WINDOW_MS"] = str(window_ms)
+    else:
+        os.environ.pop("BQUERYD_TPU_BATCH_WINDOW_MS", None)
+    try:
+        def client(ci):
+            try:
+                rpc = RPC(
+                    coordination_url=url, timeout=RPC_TIMEOUT,
+                    loglevel=logging.WARNING,
+                )
+                for k, query in enumerate(queries_by_client[ci]):
+                    barrier.wait(timeout=300)
+                    t0 = time.perf_counter()
+                    frame = rpc.groupby(*query)
+                    wall = time.perf_counter() - t0
+                    with lock:
+                        walls.append(wall)
+                        results[(ci, k)] = frame
+            except Exception as exc:  # surfaced to the caller below
+                errors.append(exc)
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+        threads = [
+            threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        elapsed = time.perf_counter() - t0
+    finally:
+        if prior is None:
+            os.environ.pop("BQUERYD_TPU_BATCH_WINDOW_MS", None)
+        else:
+            os.environ["BQUERYD_TPU_BATCH_WINDOW_MS"] = prior
+    if errors:
+        raise errors[0]
+    return results, walls, elapsed
+
+
+def _conc_frames_match(a, b, key_cols):
+    """(identical, float_max_rel_err): ints bit-exact, floats to
+    reassociation ulps — the same contract as the merge parity probes."""
+    a = a.sort_values(key_cols).reset_index(drop=True)
+    b = b.sort_values(key_cols).reset_index(drop=True)
+    if len(a) != len(b):
+        return False, float("inf")
+    identical = True
+    max_rel = 0.0
+    for col in a.columns:
+        x = a[col].to_numpy()
+        y = b[col].to_numpy()
+        if x.dtype.kind in "iub":
+            identical = identical and bool(np.array_equal(x, y))
+        else:
+            xf = x.astype(np.float64)
+            yf = y.astype(np.float64)
+            identical = identical and bool(
+                np.allclose(xf, yf, rtol=1e-9, equal_nan=True)
+            )
+            with np.errstate(all="ignore"):
+                rel = (
+                    np.nanmax(
+                        np.abs(xf - yf) / np.maximum(np.abs(yf), 1e-30)
+                    )
+                    if len(xf) else 0.0
+                )
+            max_rel = max(max_rel, float(rel))
+    return identical, max_rel
+
+
 def run_chaos_section(names):
     """The chaos gate: each scripted scenario (kill-worker, drop-reply,
     wedge-device, redis-partition) runs the burst over its own fresh
@@ -1069,9 +1161,11 @@ def main():
                     # normalize hints, so the comparison is noise-bounded —
                     # a loose min reads scheduler jitter as a route delta
                     a_strategies = None
-                    for _ in range(max(REPEATS, 5)):
+
+                    def one_adaptive():
+                        nonlocal a_strategies
                         t0 = time.perf_counter()
-                        a_result = rpc.groupby(files, gcols, aggs, where)
+                        result = rpc.groupby(files, gcols, aggs, where)
                         a_walls.append(time.perf_counter() - t0)
                         # captured INSIDE the loop: after the interleave the
                         # client's last_call_strategies belongs to the
@@ -1079,15 +1173,37 @@ def main():
                         a_strategies = getattr(
                             rpc, "last_call_strategies", None
                         )
+                        return result
+
+                    def one_static():
                         os.environ["BQUERYD_TPU_PLANNER"] = "0"
                         try:
                             t0 = time.perf_counter()
-                            s_result = rpc.groupby(files, gcols, aggs, where)
+                            result = rpc.groupby(files, gcols, aggs, where)
                             s_walls.append(time.perf_counter() - t0)
                         finally:
                             os.environ.pop("BQUERYD_TPU_PLANNER", None)
+                        return result
+
+                    # pairs alternate order (adaptive-first / static-first),
+                    # same as the obs section: always measuring adaptive
+                    # first systematically charged it whatever cost the
+                    # previous pair's tail left behind (GC, page cache churn)
+                    # — the r8 highcard "regret" of 0.55 s on an
+                    # identical-program backend was exactly that bias
+                    for i in range(max(REPEATS, 5)):
+                        if i % 2 == 0:
+                            a_result = one_adaptive()
+                            s_result = one_static()
+                        else:
+                            s_result = one_static()
+                            a_result = one_adaptive()
+                    import statistics as _stats
+
                     adaptive_wall = min(a_walls)
                     static_wall = min(s_walls)
+                    adaptive_median = _stats.median(a_walls)
+                    static_median = _stats.median(s_walls)
                     check_result(
                         a_result, base_dfs[pcfg], gcols, aggs,
                         f"{pcfg}+adaptive",
@@ -1147,6 +1263,26 @@ def main():
                     "regret_gate_applies": matmul_legal,
                     "regret_within_10pct": bool(
                         adaptive_wall <= 1.10 * best_static
+                    ),
+                    # noise-robust twin: paired-alternated medians.  On
+                    # hint-normalizing backends (CPU: adaptive and static
+                    # run the IDENTICAL program) milli-scale walls are
+                    # noise-dominated, so the every-config gate requires
+                    # BOTH the min AND the median comparison to exceed 10%
+                    # before calling a regression (the r8 highcard regret —
+                    # 0.55 s systematic, 45% — fails both; one-sided
+                    # scheduler noise fails at most one)
+                    "adaptive_median_s": round(adaptive_median, 4),
+                    "static_median_s": round(static_median, 4),
+                    "regret_median_s": round(
+                        adaptive_median - static_median, 4
+                    ),
+                    "median_regret_within_10pct": bool(
+                        adaptive_median <= 1.10 * static_median
+                    ),
+                    "noise_robust_within_10pct": bool(
+                        adaptive_wall <= 1.10 * static_wall
+                        or adaptive_median <= 1.10 * static_median
                     ),
                 }
                 print(
@@ -1213,14 +1349,26 @@ def main():
                 for pcfg, entry in planner_detail.items():
                     if not isinstance(entry, dict):
                         continue
-                    if not entry.get("regret_gate_applies"):
-                        continue
-                    assert entry.get("regret_within_10pct"), (
-                        f"planner regret gate: {pcfg} adaptive "
-                        f"{entry['adaptive_wall_s']}s exceeds 1.10x best "
-                        f"static {entry['best_static_wall_s']}s "
-                        f"(regret {entry['regret_s']}s)"
-                    )
+                    if entry.get("regret_gate_applies"):
+                        assert entry.get("regret_within_10pct"), (
+                            f"planner regret gate: {pcfg} adaptive "
+                            f"{entry['adaptive_wall_s']}s exceeds 1.10x best "
+                            f"static {entry['best_static_wall_s']}s "
+                            f"(regret {entry['regret_s']}s)"
+                        )
+                    if "noise_robust_within_10pct" in entry:
+                        # this gate applies EVERYWHERE (highcard included):
+                        # a SYSTEMATIC adaptive regression shows in both
+                        # the min and the paired-alternated median; it must
+                        # not exceed 10% in both at once
+                        assert entry.get("noise_robust_within_10pct"), (
+                            f"planner regret gate (all configs): {pcfg} "
+                            f"adaptive min {entry['adaptive_wall_s']}s / "
+                            f"median {entry['adaptive_median_s']}s both "
+                            f"exceed 1.10x static "
+                            f"(min {entry['static_wall_s']}s, median "
+                            f"{entry['static_median_s']}s)"
+                        )
 
         # observability: registry snapshots bracket a headline groupby wall
         # (perf regressions come with phase attribution for free — the
@@ -1801,6 +1949,268 @@ def main():
                 else:
                     os.environ["BQUERYD_TPU_DEVICE_MERGE"] = prior_dm
 
+        # concurrency: shared-scan multi-query fusion — a closed-loop
+        # multi-client swarm of DISTINCT-but-compatible queries (same shard
+        # set + group keys; every query carries its own never-repeated
+        # filter threshold, the traffic shape PR-1's bit-identical dedup
+        # can never fuse) measured with the admission window ON (compatible
+        # queries fuse into shared-scan bundles: one decode/align/H2D pass,
+        # one mesh program per micro-batch) vs OFF (every query pays its
+        # own scan).  Gates: fused QPS >= 1.3x unfused, per-query results
+        # bit-identical to window-0 execution (ints exact, floats to
+        # reassociation ulps), plan_shared_dispatches > 0, and the PR-1
+        # identical-query dedup probe actually firing.
+        concurrency_detail = {}
+        if (
+            os.environ.get("BENCH_CONCURRENCY", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            controller_node, worker_node = nodes[0], nodes[1]
+            coord_url = controller_node.store.url
+            n_clients = int(os.environ.get("BENCH_CONC_CLIENTS", "8"))
+            rounds = int(os.environ.get("BENCH_CONC_ROUNDS", "4"))
+            window_ms = os.environ.get("BENCH_CONC_WINDOW_MS", "40")
+            try:
+                import statistics as _stats
+
+                import bqueryd_tpu.ops as ops_mod
+                from bqueryd_tpu.storage.ctable import column_cache_stats
+
+                def swarm_queries(base, step=0.013):
+                    """n_clients x rounds distinct-but-compatible queries:
+                    same shards + group key, unique filter threshold each —
+                    no two queries identical, so nothing short of
+                    shared-scan fusion can share their work."""
+                    return [
+                        [
+                            (
+                                names,
+                                ["passenger_count"],
+                                [["fare_amount", "sum", "fare_sum"]],
+                                [[
+                                    "trip_distance", ">",
+                                    round(
+                                        base + step * (ci * rounds + k), 4
+                                    ),
+                                ]],
+                            )
+                            for k in range(rounds)
+                        ]
+                        for ci in range(n_clients)
+                    ]
+
+                # (0) PR-1 identical-dedup probe: two concurrent IDENTICAL
+                # calls at window 0 must fuse into one dispatch — the
+                # sharing path that predates bundles, proven live here
+                # (plan_shared_dispatches sat at 0 in every bench round
+                # because the main loop is single-client sequential)
+                c_before = dict(controller_node.counters)
+                probe_q = [
+                    [(
+                        names, ["passenger_count"],
+                        [["fare_amount", "sum", "fare_amount"]],
+                        [["trip_distance", ">", 9.37]],
+                    )]
+                ] * 2
+                _conc_swarm(coord_url, probe_q, None)
+                identical_probe = {
+                    "shared_dispatches": (
+                        controller_node.counters["plan_shared_dispatches"]
+                        - c_before["plan_shared_dispatches"]
+                    ),
+                    "dispatched_shards": (
+                        controller_node.counters["dispatched_shards"]
+                        - c_before["dispatched_shards"]
+                    ),
+                }
+
+                counting = {"n": 0}
+                real_factorize = ops_mod.factorize
+
+                def counting_factorize(*a, **k):
+                    counting["n"] += 1
+                    return real_factorize(*a, **k)
+
+                def leg_stats_before():
+                    ws = (
+                        worker_node._mesh_executor.workingset.stats()
+                        if worker_node._mesh_executor else None
+                    )
+                    return {
+                        "counters": dict(controller_node.counters),
+                        "decode_misses": column_cache_stats()["misses"],
+                        "factorize": counting["n"],
+                        "codes_misses": (
+                            ws["codes"]["misses"] if ws else 0
+                        ),
+                    }
+
+                def leg_stats_delta(before, n_queries):
+                    ws = (
+                        worker_node._mesh_executor.workingset.stats()
+                        if worker_node._mesh_executor else None
+                    )
+                    counters = controller_node.counters
+                    return {
+                        "decode_misses_per_query": round(
+                            (
+                                column_cache_stats()["misses"]
+                                - before["decode_misses"]
+                            ) / n_queries, 3,
+                        ),
+                        "factorize_calls_per_query": round(
+                            (counting["n"] - before["factorize"])
+                            / n_queries, 3,
+                        ),
+                        "codes_misses_per_query": round(
+                            (
+                                (ws["codes"]["misses"] if ws else 0)
+                                - before["codes_misses"]
+                            ) / n_queries, 3,
+                        ),
+                        "shared_dispatches": (
+                            counters["plan_shared_dispatches"]
+                            - before["counters"]["plan_shared_dispatches"]
+                        ),
+                        "bundles": (
+                            counters["plan_bundles"]
+                            - before["counters"]["plan_bundles"]
+                        ),
+                        "bundled_queries": (
+                            counters["plan_bundled_queries"]
+                            - before["counters"]["plan_bundled_queries"]
+                        ),
+                        "dispatched_shards": (
+                            counters["dispatched_shards"]
+                            - before["counters"]["dispatched_shards"]
+                        ),
+                    }
+
+                # warmup (disjoint thresholds): compiles the bundle program
+                # for the swarm's member count — cold compile walls belong
+                # to warmup, not the measured legs
+                _conc_swarm(
+                    coord_url,
+                    [
+                        [q] for q in [
+                            c[0] for c in swarm_queries(base=20.0)
+                        ]
+                    ],
+                    window_ms,
+                )
+
+                ops_mod.factorize = counting_factorize
+                try:
+                    queries = swarm_queries(base=0.5)
+                    n_queries = n_clients * rounds
+
+                    # (1) fused leg: window ON — compatible queries bundle
+                    before_f = leg_stats_before()
+                    fused_results, fused_walls, fused_elapsed = _conc_swarm(
+                        coord_url, queries, window_ms
+                    )
+                    fused_delta = leg_stats_delta(before_f, n_queries)
+
+                    # (2) unfused leg: window 0 on the SAME query set —
+                    # bit-identical PR-8 behaviour, every query its own
+                    # scan (codes folds stay cold: the fused leg shares the
+                    # UNMASKED codes entry and creates no per-query folds)
+                    before_u = leg_stats_before()
+                    unfused_results, unfused_walls, unfused_elapsed = (
+                        _conc_swarm(coord_url, queries, None)
+                    )
+                    unfused_delta = leg_stats_delta(before_u, n_queries)
+                finally:
+                    ops_mod.factorize = real_factorize
+
+                parity_bad = []
+                max_rel = 0.0
+                for qkey, fused_frame in fused_results.items():
+                    identical, rel = _conc_frames_match(
+                        fused_frame, unfused_results[qkey],
+                        ["passenger_count"],
+                    )
+                    max_rel = max(max_rel, rel)
+                    if not identical:
+                        parity_bad.append(qkey)
+
+                def pct(walls, q):
+                    walls = sorted(walls)
+                    return walls[
+                        min(int(len(walls) * q), len(walls) - 1)
+                    ]
+
+                qps_fused = n_queries / fused_elapsed
+                qps_unfused = n_queries / unfused_elapsed
+                concurrency_detail = {
+                    "clients": n_clients,
+                    "rounds": rounds,
+                    "queries_per_leg": n_queries,
+                    "window_ms": float(window_ms),
+                    "fused_qps": round(qps_fused, 2),
+                    "unfused_qps": round(qps_unfused, 2),
+                    "qps_ratio": round(qps_fused / qps_unfused, 3),
+                    "fused_p50_s": round(pct(fused_walls, 0.50), 4),
+                    "fused_p99_s": round(pct(fused_walls, 0.99), 4),
+                    "unfused_p50_s": round(pct(unfused_walls, 0.50), 4),
+                    "unfused_p99_s": round(pct(unfused_walls, 0.99), 4),
+                    "fused": fused_delta,
+                    "unfused": unfused_delta,
+                    "identical_probe": identical_probe,
+                    "parity_identical": not parity_bad,
+                    "parity_float_max_rel_err": max_rel,
+                    "note": (
+                        "fused = BQUERYD_TPU_BATCH_WINDOW_MS window on: "
+                        "compatible concurrent queries share one "
+                        "decode/align/H2D pass and one mesh program per "
+                        "micro-batch; unfused = window 0 (PR-8 behaviour). "
+                        "Same distinct-query set both legs; gate: fused "
+                        "QPS >= 1.3x unfused, per-query parity ints "
+                        "bit-exact / floats to reassociation ulps, "
+                        "shared_dispatches > 0"
+                    ),
+                }
+                print(
+                    f"[bench] concurrency: fused {qps_fused:.1f} qps vs "
+                    f"unfused {qps_unfused:.1f} qps "
+                    f"({qps_fused / qps_unfused:.2f}x), "
+                    f"bundles {fused_delta['bundles']}, shared "
+                    f"{fused_delta['shared_dispatches']}, parity "
+                    f"{not parity_bad}, identical probe {identical_probe}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                # THE GATE (BENCH_CONCURRENCY_GATE=0 records without
+                # asserting — probe runs on noisy boxes)
+                if os.environ.get("BENCH_CONCURRENCY_GATE", "1") == "1":
+                    assert not parity_bad, (
+                        f"shared-scan parity failed for {parity_bad[:4]} "
+                        f"(float_max_rel_err {max_rel})"
+                    )
+                    assert fused_delta["shared_dispatches"] > 0, (
+                        "fused leg recorded no shared dispatches: the "
+                        "window never formed a bundle"
+                    )
+                    assert identical_probe["shared_dispatches"] > 0, (
+                        f"PR-1 identical-query dedup never fired: "
+                        f"{identical_probe}"
+                    )
+                    assert qps_fused >= 1.3 * qps_unfused, (
+                        f"fused QPS {qps_fused:.1f} < 1.3x unfused "
+                        f"{qps_unfused:.1f}"
+                    )
+            except AssertionError:
+                raise  # the concurrency gate is deterministic: fail the bench
+            except Exception as exc:
+                if os.environ.get("BENCH_CONCURRENCY_GATE", "1") == "1":
+                    raise
+                print(
+                    f"[bench] concurrency section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         # chaos: the zero-failed-query degradation gate — scripted
         # kill-worker / drop-reply / wedge-device / redis-partition
         # scenarios over fresh 2-replica clusters of the same dataset,
@@ -1923,6 +2333,10 @@ def main():
             # DEVICE_MERGE=0 host-gather payload bytes, the <=10% gate,
             # and the =1 vs =0 parity probes
             "merge": merge_detail,
+            # shared-scan multi-query fusion: closed-loop swarm QPS window
+            # on vs off, per-query parity, amortization counters, and the
+            # PR-1 identical-dedup probe
+            "concurrency": concurrency_detail,
             # fault-injection scenarios: zero-failed-query gate, result
             # parity vs the fault-free run, failover/hedge counters
             "chaos": chaos_detail,
@@ -1989,6 +2403,30 @@ def main():
                             "overlap_ratio"
                         ),
                         "merge_d2h_ratio": merge_detail.get("d2h_ratio"),
+                        # working-set / storage-decode hit-rate panel: the
+                        # cache posture behind the shared-scan economics
+                        "workingset_hit_rates": {
+                            seg: (stats or {}).get("hit_rate")
+                            for seg, stats in {
+                                **(
+                                    pipeline_detail.get("caches", {}).get(
+                                        "workingset"
+                                    ) or {}
+                                ),
+                                "storage_decode": pipeline_detail.get(
+                                    "caches", {}
+                                ).get("storage_decode"),
+                            }.items()
+                        } if pipeline_detail.get("caches") else None,
+                        "conc_qps_ratio": concurrency_detail.get(
+                            "qps_ratio"
+                        ),
+                        "conc_shared_dispatches": (
+                            concurrency_detail.get("fused") or {}
+                        ).get("shared_dispatches"),
+                        "conc_parity": concurrency_detail.get(
+                            "parity_identical"
+                        ),
                         "chaos_zero_failed": chaos_detail.get(
                             "zero_failed_queries"
                         ),

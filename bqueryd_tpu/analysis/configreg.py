@@ -195,6 +195,15 @@ ENV_REGISTRY = {
            "measured kernel walls a strategy cell needs before calibration "
            "trusts it",
            related=("CALIB",)),
+        _v("BATCH_WINDOW_MS", "float", "0",
+           "admission micro-batch window: hold admitted groupby plans this "
+           "many ms so compatible concurrent queries fuse into one "
+           "shared-scan bundle (0 = off, single-query behaviour)",
+           related=("BATCH_MAX",)),
+        _v("BATCH_MAX", "int", "16",
+           "member-query cap per micro-batch flush (a full window flushes "
+           "early)",
+           related=("BATCH_WINDOW_MS",)),
         _v("ADMIT_MAX_ACTIVE", "int", "64",
            "concurrent executing plans before queueing"),
         _v("ADMIT_QUEUE_DEPTH", "int", "256",

@@ -81,7 +81,15 @@ CONTROLLER_VERBS = (
 #: keys keep working as plain dict entries everywhere (tests, bench, info)
 COUNTER_SPECS = {
     "plan_pruned_shards": "shards excluded at plan time by advertised stats",
-    "plan_shared_dispatches": "identical concurrent work fused into one dispatch",
+    "plan_shared_dispatches":
+        "concurrent queries that joined an existing dispatch instead of "
+        "paying their own (identical-work dedup + shared-scan bundle "
+        "members beyond the first)",
+    "plan_bundles":
+        "shared-scan bundle dispatches: one decode/align/upload pass and "
+        "one mesh program serving a whole compatible micro-batch",
+    "plan_bundled_queries":
+        "member queries that rode a shared-scan bundle dispatch",
     "plan_strategy_hints": "non-auto kernel-strategy hints issued",
     "plan_calibrated_overrides":
         "dispatches where measured walls overrode the heuristic route",
@@ -240,6 +248,11 @@ class ControllerNode:
         self._work_subscribers = {}   # shard token -> [parent_token, ...]
         self._work_keys = {}          # shard token -> shared-dispatch key
         self._work_index = {}         # shared-dispatch key -> shard token
+        # admission micro-batch window (plan.bundle): admitted groupby
+        # plans staged here until the window closes, then flushed grouped
+        # by compatibility signature; empty (and bypassed) at window 0
+        self._pending_window = []     # [(msg, plan, kwargs), ...]
+        self._window_opened = 0.0
         # -- observability ---------------------------------------------------
         from bqueryd_tpu import obs
 
@@ -401,7 +414,15 @@ class ControllerNode:
                     self.free_dead_workers()
                     self.retry_stale_dispatches()
                     self.maybe_hedge()
-                    events = dict(self.poller.poll(int(POLLING_TIMEOUT * 1000)))
+                    # a pending micro-batch window bounds the poll sleep:
+                    # the flush must fire when the window closes, not a full
+                    # POLLING_TIMEOUT later (closed-loop clients send
+                    # nothing while their queries sit staged)
+                    timeout_s = POLLING_TIMEOUT
+                    if self._pending_window:
+                        remaining = self._window_deadline() - time.time()
+                        timeout_s = max(min(timeout_s, remaining), 0.0)
+                    events = dict(self.poller.poll(int(timeout_s * 1000)))
                     if self.socket in events:
                         # drain everything available this tick
                         while True:
@@ -411,6 +432,7 @@ class ControllerNode:
                                 break
                             self.handle_in(frames)
                     self._admit_ready()
+                    self._flush_window()
                     self.dispatch_pending()
                 except Exception:
                     self.logger.exception("error in controller loop")
@@ -1610,6 +1632,32 @@ class ControllerNode:
             for p in parents:
                 self.abort_parent(p, msg.get("payload"))
             return
+        if msg.get("_bundle_parents"):
+            if msg.get("bundle_members") is not None:
+                # shared-scan bundle reply: one envelope, one payload PER
+                # member — demultiplexed into each member's own segment
+                self._demux_bundle(msg)
+            else:
+                # a bundle dispatch answered WITHOUT the bundle_members key:
+                # a pre-PR-9 worker executed only the positional params
+                # (member 0's query).  Falling through to the shared-
+                # dispatch sink would hand that one payload to EVERY
+                # member — silent wrong results.  Abort all members with
+                # the mixed-version error MIGRATION.md promises instead.
+                self.logger.warning(
+                    "bundle %s answered without bundle_members "
+                    "(pre-PR-9 worker?); aborting its members",
+                    token,
+                )
+                for p in dict.fromkeys(msg["_bundle_parents"].values()):
+                    self.abort_parent(
+                        p,
+                        "bundle dispatched to a worker that does not "
+                        "understand shared-scan bundles; keep "
+                        "BQUERYD_TPU_BATCH_WINDOW_MS=0 until every calc "
+                        "worker is upgraded (see MIGRATION.md PR 9)",
+                    )
+            return
         filename = msg.get("filename")
         # a batched shard-group reply covers several filenames with ONE
         # already-merged payload (the worker's on-device psum merge);
@@ -1654,6 +1702,72 @@ class ControllerNode:
             self._maybe_complete_segment(p)
         if not delivered:
             self.logger.warning("orphaned result for parent %s dropped", parent)
+
+    def _demux_bundle(self, msg):
+        """Per-member demultiplex of a shared-scan bundle reply: the data
+        frame is one pickled ``{"payloads": {member_id: bytes}, "errors":
+        {member_id: text}}`` envelope.  Fault isolation is per member: an
+        errored/expired member aborts ITS parent only; members whose
+        parents aborted earlier (supersede, deadline) are skipped; the
+        others complete normally."""
+        token = msg.get("token")
+        data = msg.get("data") or b""
+        # payload bytes over the wire, once per reply (the controller-side
+        # twin of the worker's reply_bytes histogram)
+        self.counters["reply_payload_bytes"] += len(data)
+        bundle_parents = msg.get("_bundle_parents") or {}
+        try:
+            envelope = pickle.loads(data) if data else {}
+        except Exception:
+            for parent in set(bundle_parents.values()):
+                self.abort_parent(parent, "undecodable bundle reply")
+            return
+        member_payloads = envelope.get("payloads") or {}
+        member_errors = envelope.get("errors") or {}
+        filename = msg.get("filename")
+        key = tuple(filename) if isinstance(filename, list) else (filename,)
+        delivered = False
+        counted_duplicate = False
+        for member_id, parent in bundle_parents.items():
+            segment = self.rpc_segments.get(parent)
+            if segment is None:
+                continue  # that member aborted earlier
+            delivered = True
+            error = member_errors.get(member_id)
+            if error is not None:
+                # member-only failure (deadline expiry, a member-shape
+                # rejection): abort THIS member; bundle-mates complete
+                self.abort_parent(parent, error)
+                continue
+            buf = member_payloads.get(member_id)
+            if buf is None:
+                self.abort_parent(
+                    parent, "bundle reply missing this member's payload"
+                )
+                continue
+            if key in segment["results"] and not counted_duplicate:
+                # same dedup backstop as the shared-dispatch sink: a
+                # duplicate envelope overwrites its own identical payloads
+                self.counters["duplicate_replies"] += 1
+                counted_duplicate = True
+            segment["results"][key] = buf
+            segment["timings"][key] = msg.get("phase_timings")
+            effective = msg.get("effective_strategy")
+            if isinstance(effective, str):
+                segment.setdefault("effective", {})[key] = effective
+            merge_mode = msg.get("merge_mode")
+            if isinstance(merge_mode, str):
+                segment.setdefault("merge", {})[key] = merge_mode
+            spans = msg.get("spans")
+            if isinstance(spans, list) and segment.get("obs"):
+                segment["obs"]["spans"].extend(
+                    s for s in spans if isinstance(s, dict)
+                )
+            self._maybe_complete_segment(parent)
+        if not delivered:
+            self.logger.warning(
+                "orphaned bundle result %s dropped", token
+            )
 
     def _maybe_complete_segment(self, parent):
         """Reply to the client once every requested shard is covered (by a
@@ -2398,7 +2512,7 @@ class ControllerNode:
             return
         self._ticket_sigs[msg["token"]] = req_sig
         try:
-            self._launch_plan(msg, plan, kwargs)
+            self._stage_plan(msg, plan, kwargs)
         except Exception:
             self.admission.release(msg["token"])
             self._ticket_sigs.pop(msg["token"], None)
@@ -2409,6 +2523,21 @@ class ControllerNode:
         active run is detached from its work units and finished with no
         reply (replying would mis-pair with the identity's next request);
         a still-queued one is dropped before it ever launches."""
+        # a plan still STAGED in the micro-batch window has no segment yet:
+        # drop it before the flush can launch it — its reply would queue as
+        # a stale extra answer for this identity's NEXT request
+        staged = [
+            entry for entry in self._pending_window
+            if entry[0].get("token") == ticket
+        ]
+        if staged:
+            self._pending_window = [
+                entry for entry in self._pending_window
+                if entry[0].get("token") != ticket
+            ]
+            if self.admission.release(ticket):
+                self._ticket_sigs.pop(ticket, None)
+            return
         parent = next(
             (
                 p for p, s in self.rpc_segments.items()
@@ -2449,7 +2578,7 @@ class ControllerNode:
                 for payload in launch:
                     msg, plan, kwargs = payload
                     try:
-                        self._launch_plan(msg, plan, kwargs)
+                        self._stage_plan(msg, plan, kwargs)
                     except Exception as exc:
                         self.logger.exception("queued plan launch failed")
                         self.admission.release(msg["token"])
@@ -2464,33 +2593,85 @@ class ControllerNode:
         finally:
             self._admitting = False
 
-    def _launch_plan(self, msg, plan, kwargs):
-        from bqueryd_tpu import obs
+    def _stage_plan(self, msg, plan, kwargs):
+        """Launch now (window 0 — bit-identical to the pre-window path) or
+        stage into the admission micro-batch window so concurrent
+        compatible queries can fuse into one shared-scan dispatch."""
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        window_ms = bundlemod.batch_window_ms()
+        if window_ms <= 0:
+            self._launch_plan(msg, plan, kwargs)
+            return
+        if not self._pending_window:
+            self._window_opened = time.time()
+        self._pending_window.append((msg, plan, kwargs))
+        if len(self._pending_window) >= bundlemod.batch_max():
+            self._flush_window(force=True)
+
+    def _window_deadline(self):
+        """Absolute time the open micro-batch window closes."""
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        return self._window_opened + bundlemod.batch_window_ms() / 1000.0
+
+    def _flush_window(self, force=False):
+        """Close the micro-batch window: group the staged plans by
+        compatibility signature, launch each compatible group as ONE
+        shared-scan bundle, and everything else individually.  A launch
+        failure is replied per member (same contract as ``_admit_ready``)
+        and never poisons the other groups."""
+        if not self._pending_window:
+            return
+        if not force and time.time() < self._window_deadline():
+            return
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        pending, self._pending_window = self._pending_window, []
+        groups = {}
+        for staged in pending:
+            msg, plan, kwargs = staged
+            try:
+                keep, pruned = self._prune_shards(plan)
+                key = bundlemod.compat_key(plan, keep, kwargs)
+            except Exception:
+                # one malformed plan must not poison the whole window:
+                # group it solo; its own launch path replies the error
+                self.logger.exception("window compatibility probe failed")
+                keep, pruned, key = list(plan.filenames), [], None
+            if key is None:
+                # unfusable (raw rows, basket expansion, non-mergeable
+                # aggs, batch=False, fully pruned): solo launch
+                key = ("solo", id(msg))
+            groups.setdefault(key, []).append((msg, plan, kwargs, keep, pruned))
+        for entries in groups.values():
+            try:
+                if len(entries) == 1:
+                    msg, plan, kwargs, keep, pruned = entries[0]
+                    self._launch_plan(
+                        msg, plan, kwargs, preplanned=(keep, pruned)
+                    )
+                else:
+                    self._launch_bundle(entries)
+            except Exception as exc:
+                self.logger.exception("window flush launch failed")
+                for msg, _plan, _kwargs, _keep, _pruned in entries:
+                    self.admission.release(msg["token"])
+                    self._ticket_sigs.pop(msg["token"], None)
+                    self.reply_rpc_raw(
+                        msg["token"],
+                        pickle.dumps(
+                            {"ok": False, "error": f"{exc}"}, protocol=4
+                        ),
+                    )
+
+    def _prune_shards(self, plan):
+        """Plan-time shard pruning: ``(keep, pruned)`` — a shard whose
+        advertised min/max stats exclude the pushed-down predicate
+        conjunction is never dispatched."""
         from bqueryd_tpu import plan as planmod
 
-        parent_token = os.urandom(8).hex()
         planner_on = planmod.planner_enabled()
-        # observability state: created in rpc_groupby; a traceless caller
-        # (tests driving _launch_plan directly) gets a fresh one here
-        obs_state = msg.get("_obs")
-        if not isinstance(obs_state, dict):
-            obs_state = self._new_obs_state(obs.TraceContext.new_root())
-        # the admission span covers submit -> launch: ~0 for an immediate
-        # ADMIT, the real queue wait for plans launched by _admit_ready
-        if obs.enabled():
-            obs_state["spans"].append(
-                obs.make_span(
-                    obs_state["trace_id"], "admission",
-                    obs_state["submitted_ts"],
-                    max(time.time() - obs_state["submitted_ts"], 0.0),
-                    parent_span_id=obs_state["qspan_id"], node=self.address,
-                )
-            )
-
-        # plan-time shard pruning: a shard whose advertised min/max stats
-        # exclude the pushed-down predicate conjunction is never dispatched —
-        # its (provably empty) payload slot is pre-filled so the client-side
-        # merge contract is unchanged
         keep, pruned = [], []
         for f in plan.filenames:
             stats = self.shard_stats.get(f)
@@ -2503,8 +2684,33 @@ class ControllerNode:
                 pruned.append(f)
             else:
                 keep.append(f)
-        self.counters["plan_pruned_shards"] += len(pruned)
+        return keep, pruned
 
+    def _open_query_segment(self, msg, plan, pruned):
+        """Per-query result segment + observability state (shared by the
+        solo launch path and every bundle member — a member keeps its own
+        trace, deadline, quota ticket and reply identity).  Pruned shards'
+        (provably empty) payload slots are pre-filled so the client-side
+        merge contract is unchanged."""
+        from bqueryd_tpu import obs
+
+        parent_token = os.urandom(8).hex()
+        # observability state: created in rpc_groupby; a traceless caller
+        # (tests driving _launch_plan directly) gets a fresh one here
+        obs_state = msg.get("_obs")
+        if not isinstance(obs_state, dict):
+            obs_state = self._new_obs_state(obs.TraceContext.new_root())
+        # the admission span covers submit -> launch: ~0 for an immediate
+        # ADMIT, the queue wait (and any window time) for staged plans
+        if obs.enabled():
+            obs_state["spans"].append(
+                obs.make_span(
+                    obs_state["trace_id"], "admission",
+                    obs_state["submitted_ts"],
+                    max(time.time() - obs_state["submitted_ts"], 0.0),
+                    parent_span_id=obs_state["qspan_id"], node=self.address,
+                )
+            )
         segment = {
             "client_token": msg["token"],
             "msg": msg,
@@ -2524,6 +2730,18 @@ class ControllerNode:
             "merge": {},              # shard-group key -> merge_mode
         }
         self.rpc_segments[parent_token] = segment
+        return parent_token
+
+    def _launch_plan(self, msg, plan, kwargs, preplanned=None):
+        # ``preplanned``: the (keep, pruned) the window flush already
+        # computed for compat grouping — re-pruning every solo launch would
+        # double the plan-time stats_can_match cost on the event loop
+        keep, pruned = (
+            preplanned if preplanned is not None
+            else self._prune_shards(plan)
+        )
+        self.counters["plan_pruned_shards"] += len(pruned)
+        parent_token = self._open_query_segment(msg, plan, pruned)
         if not keep:
             # every shard pruned: answer immediately with empty payloads
             self._maybe_complete_segment(parent_token)
@@ -2536,6 +2754,109 @@ class ControllerNode:
             # work-unit registrations, and worker time on the groups that
             # DID queue — detach them all; the caller replies the error
             self.abort_parent(parent_token, "launch failed", reply=False)
+            raise
+
+    def _launch_bundle(self, entries):
+        """Launch a compatible micro-batch as shared-scan bundles: one
+        CalcMessage per shard group carrying every member's fragment; the
+        worker executes one decode/align/upload pass + one mesh program and
+        the reply demultiplexes per member (``_demux_bundle``)."""
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        _msg0, plan0, kwargs0, keep, _pruned0 = entries[0]
+        member_parents = {}     # member_id -> parent_token
+        members = []            # (member_id, plan, deadline)
+        opened = []
+        try:
+            for msg, plan, _kwargs, _keep, pruned in entries:
+                self.counters["plan_pruned_shards"] += len(pruned)
+                parent_token = self._open_query_segment(msg, plan, pruned)
+                opened.append(parent_token)
+                member_id = os.urandom(6).hex()
+                member_parents[member_id] = parent_token
+                members.append((member_id, plan, msg.get("deadline")))
+            groupby_cols = list(plan0.groupby.keys)
+            agg_list0 = plan0.physical_agg_list()
+            parents = [member_parents[m[0]] for m in members]
+            # the bundle envelope's deadline is the LAST member's (its
+            # expiry implies every member's); per-member deadlines ride the
+            # fragment and are enforced per member on the worker
+            deadlines = [m[2] for m in members]
+            bundle_deadline = (
+                max(deadlines)
+                if deadlines and all(d is not None for d in deadlines)
+                else None
+            )
+            sole = len(keep) == 1
+            affinity = kwargs0.get("affinity")
+            for group in self._shard_groups(
+                keep, groupby_cols, agg_list0, kwargs0
+            ):
+                target = group if len(group) > 1 else group[0]
+                # no per-bundle strategy selection: the shared-scan kernel
+                # always runs its own batched/auto family (the hint could
+                # only ever reach the worker's rare per-member fallback),
+                # so issuing calibrated hints here would inflate the
+                # planner-hint counters with hints that structurally
+                # cannot run
+                strategy = None
+                hint = "auto"
+                for parent in parents:
+                    segment = self.rpc_segments.get(parent)
+                    if segment is not None:
+                        segment["strategies"][hint] = (
+                            segment["strategies"].get(hint, 0) + len(group)
+                        )
+                shard = CalcMessage({"payload": "groupby"})
+                if sole:
+                    shard["sole_shard"] = True
+                # reference-shaped params carry the FIRST member's query so
+                # _split_batch re-splitting keeps working; the bundle
+                # fragment is authoritative on capable workers (MIGRATION:
+                # enable the window only on >=PR-9 fleets)
+                shard.set_args_kwargs(
+                    [target, groupby_cols, agg_list0,
+                     [list(t) for t in plan0.where_terms]],
+                    {},
+                )
+                shard["token"] = os.urandom(8).hex()
+                shard["parent_token"] = parents[0]
+                shard["filename"] = target
+                shard["affinity"] = affinity
+                obs_state = (
+                    self.rpc_segments.get(parents[0], {}).get("obs") or {}
+                )
+                if obs_state:
+                    shard.set_trace(
+                        {
+                            "trace_id": obs_state["trace_id"],
+                            "span_id": os.urandom(8).hex(),
+                            "parent_span_id": obs_state["qspan_id"],
+                        }
+                    )
+                    shard["_dispatch_queued_ts"] = time.time()
+                if bundle_deadline is not None:
+                    shard["deadline"] = bundle_deadline
+                shard.add_as_binary(
+                    "bundle",
+                    bundlemod.bundle_fragment(
+                        plan0, group, members, strategy=strategy, sole=sole
+                    ),
+                )
+                shard["_bundle_parents"] = dict(member_parents)
+                self._register_work(shard, parents)
+                self.counters["plan_bundles"] += 1
+                self.counters["plan_bundled_queries"] += len(members)
+                # every member beyond the first shares a dispatch it would
+                # otherwise have paid for itself — the same meaning the
+                # identical-work dedup counter always had
+                self.counters["plan_shared_dispatches"] += len(members) - 1
+                self.worker_out_messages.setdefault(affinity, []).append(
+                    shard
+                )
+        except Exception:
+            for parent in opened:
+                self.abort_parent(parent, "bundle launch failed", reply=False)
             raise
 
     def _dispatch_plan(self, msg, plan, kwargs, parent_token, keep):

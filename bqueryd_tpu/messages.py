@@ -87,6 +87,11 @@ ENVELOPE_SCHEMA = {
     "affinity": "pin dispatch to one worker id",
     "sole_shard": "single-shard query: worker may finalize on device",
     "plan": "base64-pickled plan fragment (query + predicates + strategy)",
+    "bundle": "base64-pickled shared-scan bundle fragment: shared shard "
+              "group + group-key columns plus one record per member query "
+              "(member_id, aggs, filters, deadline) — the worker executes "
+              "the whole compatible micro-batch as one scan "
+              "(plan.bundle.bundle_fragment)",
     "worker_id": "explicit dispatch target / WRM sender identity",
     "ticket": "download/movebcolz ticket id",
     # worker -> controller replies
@@ -103,6 +108,11 @@ ENVELOPE_SCHEMA = {
                   "collective, final table only fetched), 'host' "
                   "(hostmerge.merge_payloads fallback), 'none' (single "
                   "payload, nothing merged)",
+    "bundle_members": "on shared-scan bundle replies: the member_id list "
+                      "the reply's data frame covers (its bytes are one "
+                      "pickled {payloads: {member_id: bytes}, errors: "
+                      "{member_id: text}} envelope the controller "
+                      "demultiplexes per member)",
     "transient": "on worker ErrorMessage replies: the failure is retryable "
                  "(chaos.TransientError class, e.g. DeviceBusyError) — the "
                  "controller fails the shard over to a different holder "
@@ -142,6 +152,9 @@ ENVELOPE_SCHEMA = {
                         "envelope (attempts key)",
     "_not_before": "controller-internal: failover backoff gate — the "
                    "dispatcher holds the shard until this timestamp",
+    "_bundle_parents": "controller-internal: member_id -> parent_token map "
+                       "of a bundle dispatch; rides the envelope so the "
+                       "reply (msg.copy) carries its own demux table",
     "_dispatch_queued_ts": "controller-internal: dispatch queue-entry time",
     "_relayed": "controller-internal: fan-out marker on relayed verbs",
     "_obs": "controller-internal: per-query observability state rider",
@@ -152,7 +165,13 @@ ENVELOPE_SCHEMA = {
 RESULT_ENVELOPE_SCHEMA = {
     "ok": "False when the query failed (error carries the reason)",
     "busy": "admission BUSY backpressure marker (RPCBusyError client-side)",
-    "payloads": "per-shard-group ResultPayload byte strings",
+    "payloads": "per-shard-group ResultPayload byte strings (client result "
+                "envelope); in a shared-scan bundle reply data frame, the "
+                "{member_id: ResultPayload bytes} demux map",
+    "v": "version stamp of a shared-scan bundle reply data frame",
+    "errors": "in a shared-scan bundle reply data frame: {member_id: text} "
+              "member-only failures (deadline expiry, member-shape "
+              "rejection) — the controller aborts just those members",
     "timings": "compacted per-phase timing summary",
     "strategies": "planner report: {hints: hint->dispatches, effective: "
                   "shard-group->executed kernel route}",
@@ -197,6 +216,9 @@ WIRE_ONE_SIDED_OK = {
     "pid": "operator-facing WRM field surfaced via rpc.info()",
     "uptime": "operator-facing WRM field surfaced via rpc.info()",
     "msg_count": "operator-facing WRM field surfaced via rpc.info()",
+    "v": "bundle data-envelope version stamp written by "
+         "worker._handle_bundle; the controller's demux tolerates v1 only "
+         "today, so nothing reads it yet",
 }
 
 

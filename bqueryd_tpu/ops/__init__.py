@@ -123,6 +123,7 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
     AGG_OPS,
     MERGEABLE_OPS,
     bucketize_partials,
+    bundle_partial_tables,
     combine_partials,
     expand_mask_by_group,
     finalize,
@@ -166,6 +167,7 @@ __all__ = [
     "partial_tables_bucketized",
     "program_bucket",
     "bucketize_partials",
+    "bundle_partial_tables",
     "combine_partials",
     "psum_partials",
     "finalize",

@@ -468,6 +468,183 @@ def bucketize_partials(partials, n_groups, n_buckets):
     return out, span
 
 
+def bundle_partial_tables(codes, masks, measures, member_specs, n_groups,
+                          null_sentinels=None, strategy=None):
+    """Stacked-mask shared-scan emission: per-member partial tables over ONE
+    codes array and ONE set of deduplicated measure blocks.
+
+    codes:        int[n] dense group codes, shared by every member (uploaded
+                  once, unmasked — each member's filter applies per member)
+    masks:        bool[n_masks, n] stacked row filters, one row per member
+                  that carries a filter (members without one index None)
+    measures:     tuple of value arrays [n], one per DISTINCT measure column
+                  across the whole bundle (the union upload)
+    member_specs: static tuple, one entry per member:
+                  ``(mask_idx_or_None, ((measure_slot, op), ...))`` — which
+                  stacked mask row (None = unfiltered) and which
+                  (deduplicated measure block, op) pairs this member
+                  aggregates
+    null_sentinels: optional tuple aligned with ``measures`` (per distinct
+                  column, same semantics as :func:`partial_tables`)
+
+    Returns a tuple of per-member partial-table pytrees, each shaped exactly
+    like :func:`partial_tables` produces for that member alone.
+
+    On CPU backends this is the shared-scan KERNEL, not just a member
+    loop: every (measure slot, op) family shared across members runs as
+    ONE batched segment reduction over the ``[members, rows]`` stack of
+    masked contributions — the scan/build work that dominates GROUP BY
+    cost is paid once per bundle, not once per member (measured 4x+ over
+    the member-at-a-time loop at bench shapes).  On accelerator backends
+    the batched form would be exactly the emulated wide scatter
+    (s64/f64 ``segment_sum``) that :func:`_int64_segment_sum` and
+    :func:`_sorted_segment_sum` exist to avoid, so each member runs its
+    own :func:`partial_tables` dispatch there instead — full guards, limb
+    paths and MXU routes intact; the bundle still shares every host-side
+    pass (decode/align/H2D/program dispatch), just not the reduction.
+    Backend is read at trace time, like the solo kernels' own backend
+    branches.  Exactness contract vs solo execution: integer partials are
+    bit-identical (integer segment sums are order-exact under any
+    reduction), float partials accumulate in the same widened dtype
+    (:func:`_accum_dtype`) and differ from a member's solo route at most
+    by reassociation — the same tolerance class as the matmul-vs-scatter
+    route choice."""
+    measures = tuple(measures)
+    sentinels = _normalize_sentinels(null_sentinels, len(measures))
+    for _mask_idx, aggs in member_specs:
+        for slot, op in aggs:
+            if op not in MERGEABLE_OPS and op != "count_na":
+                raise ValueError(
+                    f"op {op!r} has no mergeable partial; bundles carry "
+                    "mergeable aggregations only"
+                )
+            if sentinels[slot] is not None and op in ("sum", "mean"):
+                raise ValueError(
+                    f"op {op!r} cannot aggregate a sentinel-null measure"
+                )
+
+    if jax.default_backend() != "cpu":
+        return tuple(
+            partial_tables(
+                codes,
+                tuple(measures[slot] for slot, _op in aggs),
+                tuple(op for _slot, op in aggs),
+                n_groups,
+                mask=None if mask_idx is None else masks[mask_idx],
+                null_sentinels=tuple(
+                    sentinels[slot] for slot, _op in aggs
+                ),
+                strategy=strategy,
+            )
+            for mask_idx, aggs in member_specs
+        )
+
+    key_valid = codes >= 0
+    safe = jnp.where(key_valid, codes, 0).astype(jnp.int32)
+    n_groups = int(n_groups)
+
+    # per-member validity stack (the shared scan's one mask fold)
+    valids = tuple(
+        key_valid if mask_idx is None else key_valid & masks[mask_idx]
+        for mask_idx, _aggs in member_specs
+    )
+
+    def batched_count(flags_2d):
+        """bool[k, n] -> int64[k, n_groups] in ONE segment pass.  Counts
+        accumulate in int32 (a per-dispatch block holds < 2^31 rows) and
+        widen to the partials' int64 contract after."""
+        return jax.ops.segment_sum(
+            flags_2d.T.astype(jnp.int32), safe, num_segments=n_groups
+        ).T.astype(jnp.int64)
+
+    rows_all = batched_count(jnp.stack(valids))  # [n_q, n_groups]
+
+    nulls = {
+        slot: _measure_null(measures[slot], sentinels[slot])
+        for slot in {s for _m, aggs in member_specs for s, _o in aggs}
+    }
+
+    # job plan: one batched reduction per (measure slot, op) family across
+    # every member that needs it
+    jobs = {}
+    for qi, (_mask_idx, aggs) in enumerate(member_specs):
+        for ai, (slot, op) in enumerate(aggs):
+            jobs.setdefault((slot, op), []).append((qi, ai))
+
+    results = [
+        [None] * len(aggs) for _mask_idx, aggs in member_specs
+    ]
+    for (slot, op), takers in jobs.items():
+        values = measures[slot]
+        null = nulls[slot]
+        present = tuple(
+            valids[qi] if null is None else valids[qi] & ~null
+            for qi, _ai in takers
+        )
+        stacked = jnp.stack(present)  # [k, n]
+
+        def taker_counts():
+            if null is None:
+                return tuple(rows_all[qi] for qi, _ai in takers)
+            counted = batched_count(stacked)
+            return tuple(counted[j] for j in range(len(takers)))
+
+        if op in ("sum", "mean"):
+            floating = jnp.issubdtype(values.dtype, jnp.floating)
+            if floating or op == "mean":
+                # integer means accumulate in float like pandas (and the
+                # solo kernels) — see _partial_tables_scatter
+                acc = _accum_dtype(
+                    values.dtype if floating else jnp.float64
+                )
+            else:
+                acc = jnp.int64
+            contrib = jnp.where(stacked, values[None, :], 0).astype(acc)
+            sums = jax.ops.segment_sum(
+                contrib.T, safe, num_segments=n_groups
+            ).T
+            counts = taker_counts() if op == "mean" else None
+            for j, (qi, ai) in enumerate(takers):
+                part = {"sum": sums[j]}
+                if op == "mean":
+                    part["count"] = counts[j]
+                results[qi][ai] = part
+        elif op == "count":
+            counts = taker_counts()
+            for j, (qi, ai) in enumerate(takers):
+                results[qi][ai] = {"count": counts[j]}
+        elif op == "count_na":
+            if null is None:
+                zero = jnp.zeros(n_groups, dtype=jnp.int64)
+                for qi, ai in takers:
+                    results[qi][ai] = {"count": zero}
+            else:
+                na = batched_count(
+                    jnp.stack(tuple(valids[qi] & null for qi, _ai in takers))
+                )
+                for j, (qi, ai) in enumerate(takers):
+                    results[qi][ai] = {"count": na[j]}
+        else:  # min / max
+            src = values
+            as_bool = src.dtype == jnp.bool_
+            if as_bool:
+                src = src.astype(jnp.uint8)  # bool has no iinfo
+            fill = np.dtype(src.dtype).type(extremum_fill(src.dtype, op))
+            data = jnp.where(stacked, src[None, :], fill)
+            seg = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+            ext = seg(data.T, safe, num_segments=n_groups).T
+            if as_bool:
+                ext = ext.astype(jnp.bool_)
+            counts = taker_counts()
+            for j, (qi, ai) in enumerate(takers):
+                results[qi][ai] = {op: ext[j], "count": counts[j]}
+
+    return tuple(
+        {"rows": rows_all[qi], "aggs": tuple(results[qi])}
+        for qi in range(len(member_specs))
+    )
+
+
 def partial_tables_bucketized(codes, measures, ops, n_groups, n_buckets,
                               mask=None, null_sentinels=None, strategy=None):
     """:func:`partial_tables` with the output re-laid onto the

@@ -759,6 +759,16 @@ class MeshQueryExecutor:
             # under (hints silently normalized here until this existed —
             # neither traces nor bench could tell what actually ran)
             per_agg_d = tuple(measures_d[i] for i in measure_index)
+            # normalize the hint BEFORE predicting/labelling the route: a
+            # hint the guards would normalize inside _mesh_partials (e.g.
+            # "scatter" on a backend whose auto dispatch internally sorts)
+            # must not be reported — or recorded into calibration cells —
+            # as a route the program never ran (the highcard cell-keying
+            # bug: "scatter"-labelled walls that were really the sort path)
+            strategy = _effective_mesh_strategy(
+                strategy, tuple(query.ops), n_prog, per_agg_d,
+                int(codes_d.shape[1]),
+            )
             route = ops.kernel_route(
                 strategy, per_agg_d, tuple(query.ops),
                 int(codes_d.shape[1]), n_prog,
@@ -821,63 +831,352 @@ class MeshQueryExecutor:
                     lambda a: a[..., :n_groups], merged
                 )
 
-        def collect_payload(partial_table):
-            """One merged (or single-device) partial table -> ResultPayload
-            keyed by actual key values."""
-            rows = partial_table["rows"]
-            present = rows > 0
-            combos_present = combos[present]
-            if len(query.groupby_cols) == 1:
-                key_codes = [combos_present]
-            else:
-                key_codes = ops.unpack_codes(combos_present, cards)
-            keys = {}
-            for col, codes_g in zip(query.groupby_cols, key_codes):
-                idx = np.asarray(codes_g, dtype=np.int64)
-                keys[col] = key_values[col][idx]
-            aggs = []
-            for in_col, part in zip(query.in_cols, partial_table["aggs"]):
-                stored = _stored_dtype(tables, in_col)
-                selected = {}
-                for k, v in part.items():
-                    v = v[present]
-                    # min/max partials computed on a narrowed wire dtype go
-                    # back to the column's stored dtype
-                    if (
-                        k in ("min", "max")
-                        and stored is not None
-                        and v.dtype != stored
-                        and stored.kind in "iu"
-                    ):
-                        v = v.astype(stored)
-                    selected[k] = v
-                aggs.append(selected)
-            return ResultPayload.partials(
-                key_cols=query.groupby_cols,
-                keys=keys,
-                rows=rows[present],
-                aggs=aggs,
-                ops=query.ops,
-                out_cols=query.out_cols,
-                value_kinds=list(measure_kinds),
+        with self._phase("collect"), pipeline.stage("merge"):
+            return self._finish_collect(
+                merged, merge_mode, int(n_dev), query, tables,
+                combos, cards, key_values, measure_kinds,
             )
 
-        with self._phase("collect"), pipeline.stage("merge"):
-            if merge_mode == devicemerge.MODE_HOST:
-                # kill-switch fallback: every device's partial table left
-                # HBM whole; key them by actual key values and merge on the
-                # worker host with the always-correct value-keyed merge —
-                # bit-identical aggregates, host-gather economics
-                from bqueryd_tpu.parallel import hostmerge
+    def _collect_payload(self, partial_table, query, tables, combos, cards,
+                         key_values, measure_kinds):
+        """One merged (or single-device) partial table -> ResultPayload
+        keyed by actual key values."""
+        from bqueryd_tpu import ops
 
-                payloads = [
-                    collect_payload(
-                        jax.tree_util.tree_map(lambda a: a[d], merged)
+        rows = partial_table["rows"]
+        present = rows > 0
+        combos_present = combos[present]
+        if len(query.groupby_cols) == 1:
+            key_codes = [combos_present]
+        else:
+            key_codes = ops.unpack_codes(combos_present, cards)
+        keys = {}
+        for col, codes_g in zip(query.groupby_cols, key_codes):
+            idx = np.asarray(codes_g, dtype=np.int64)
+            keys[col] = key_values[col][idx]
+        aggs = []
+        for in_col, part in zip(query.in_cols, partial_table["aggs"]):
+            stored = _stored_dtype(tables, in_col)
+            selected = {}
+            for k, v in part.items():
+                v = v[present]
+                # min/max partials computed on a narrowed wire dtype go
+                # back to the column's stored dtype
+                if (
+                    k in ("min", "max")
+                    and stored is not None
+                    and v.dtype != stored
+                    and stored.kind in "iu"
+                ):
+                    v = v.astype(stored)
+                selected[k] = v
+            aggs.append(selected)
+        return ResultPayload.partials(
+            key_cols=query.groupby_cols,
+            keys=keys,
+            rows=rows[present],
+            aggs=aggs,
+            ops=query.ops,
+            out_cols=query.out_cols,
+            value_kinds=list(measure_kinds),
+        )
+
+    def _finish_collect(self, merged, merge_mode, n_dev, query, tables,
+                        combos, cards, key_values, measure_kinds):
+        """Merged partials (one query's pytree) -> its ResultPayload, per
+        merge mode.  Host mode re-merges the per-device tables with the
+        always-correct value-keyed merge — bit-identical aggregates,
+        host-gather economics."""
+        import jax
+
+        from bqueryd_tpu.parallel import devicemerge
+
+        if merge_mode == devicemerge.MODE_HOST:
+            from bqueryd_tpu.parallel import hostmerge
+
+            payloads = [
+                self._collect_payload(
+                    jax.tree_util.tree_map(lambda a: a[d], merged),
+                    query, tables, combos, cards, key_values, measure_kinds,
+                )
+                for d in range(int(n_dev))
+            ]
+            return ResultPayload(hostmerge.merge_payloads(payloads))
+        return self._collect_payload(
+            merged, query, tables, combos, cards, key_values, measure_kinds,
+        )
+
+    # -- shared-scan bundles -------------------------------------------------
+    def execute_bundle(self, tables, queries, strategy=None):
+        """Shared-scan execution of a compatible query bundle: every query
+        scans the same ``tables`` with the same group-key columns; measures
+        and filters may differ per member.  One decode/align/factorize pass,
+        one (unmasked) codes upload, one deduplicated union measure upload,
+        one stacked-mask H2D, and ONE mesh program whose per-member partial
+        tables merge in one collective pass.  Returns one
+        :class:`ResultPayload` per query, input order.
+
+        Parity contract: each member's partials are emitted by the same
+        per-member :func:`ops.partial_tables` dispatch its solo execution
+        would run (the mask rides the kernel's ``mask=`` argument, which
+        zeroes exactly the contributions code-folding would drop), so
+        integer aggregates are bit-identical to unfused execution and float
+        aggregates differ only by kernel-route reassociation."""
+        from bqueryd_tpu import chaos, ops
+        from bqueryd_tpu.models.query import freeze_value
+
+        if not queries:
+            return []
+        if chaos.enabled():
+            chaos.fire(
+                "worker.device",
+                n_tables=len(tables),
+                signature=f"bundle:{len(queries)}",
+            )
+        self.last_effective_strategy = None
+        self.last_merge_mode = None
+        if strategy in (None, "auto", "host"):
+            strategy = None
+        gcols = tuple(queries[0].groupby_cols)
+        for query in queries:
+            if tuple(query.groupby_cols) != gcols:
+                raise ValueError(
+                    "bundle members must share group-key columns"
+                )
+            if not self.supports(query):
+                raise ValueError(
+                    "bundle members must be mergeable aggregations"
+                )
+        # the union measure upload: every DISTINCT column across the bundle,
+        # first-seen order; per-member aggs map onto slots in this union
+        union_cols = list(
+            dict.fromkeys(c for q in queries for c in q.in_cols)
+        )
+        union_kinds = tuple(
+            _measure_kind(tables, col) for col in union_cols
+        )
+        kind_of = dict(zip(union_cols, union_kinds))
+        for query in queries:
+            for col, op in zip(query.in_cols, query.ops):
+                if kind_of[col] == "datetime" and op in ("sum", "mean"):
+                    raise ValueError(
+                        f"{op!r} is not defined for datetime column {col!r}"
                     )
-                    for d in range(int(n_dev))
-                ]
-                return ResultPayload(hostmerge.merge_payloads(payloads))
-            return collect_payload(merged)
+        engine = self._engine()
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bqueryd_tpu.parallel import devicemerge, pipeline
+
+        tables_key = tuple(_table_key(t) for t in tables)
+        cols_key = tuple(gcols)
+        mesh = self.mesh
+        n_dev = mesh.devices.size
+        merge_mode = devicemerge.resolve_mode()
+        self.last_merge_mode = (
+            "host" if merge_mode == devicemerge.MODE_HOST else "device"
+        )
+        sharding = NamedSharding(mesh, P(self.axis_name, None))
+        # the bundle's codes ride UNMASKED (each member's filter applies on
+        # device through the stacked mask axis) — which is exactly the codes
+        # entry an unfiltered single query folds, so the cache key is shared
+        # with (and warms) the plain single-query path
+        codes_key = (
+            tables_key, "codes", cols_key, (freeze_value([]), None), n_dev,
+        )
+        missing_cols = [
+            col for col in union_cols
+            if (tables_key, "col", col, n_dev) not in self._hbm_cache
+        ]
+        align_warm = (tables_key, cols_key) in self._align_cache
+        codes_warm = codes_key in self._codes_cache
+        if missing_cols or not codes_warm:
+            self.workingset.evict_under_pressure()
+
+        # prefetch depth = the whole bundle's union: every member's missing
+        # measure column fires its storage decode on the pool up front, so
+        # the shared pass never pays a member's decode inline (the single-
+        # query path prefetches only its own columns)
+        prefetch = {}
+
+        def _prefetch_missing():
+            if pipeline.pipeline_threads() <= 1:
+                return
+            for col in missing_cols:
+                futs = []
+                for t in tables:
+                    warm = getattr(t, "prefetch", None)
+                    if warm is not None:
+                        futs.extend(warm([col]))
+                if futs:
+                    prefetch[col] = futs
+
+        if align_warm:
+            _prefetch_missing()
+
+        with self._phase("align"), pipeline.stage("align"):
+            cached = self._align_cache.get((tables_key, cols_key))
+            if cached is None:
+                dense, combos, cards, key_values = self._global_key_space(
+                    tables, queries[0], engine
+                )
+                self._align_cache.put(
+                    (tables_key, cols_key),
+                    (dense, combos, cards, key_values),
+                    nbytes=sum(d.nbytes for d in dense)
+                    + combos.nbytes
+                    + sum(v.nbytes for v in key_values.values()),
+                )
+            else:
+                dense, combos, cards, key_values = cached
+            n_groups = max(len(combos), 1)
+
+        if not align_warm:
+            _prefetch_missing()
+
+        codes_d = self._codes_cache.get(codes_key)
+        if codes_d is None:
+            with self._phase("layout"):
+                with pipeline.stage("align"):
+                    cdt = _codes_dtype(n_groups)
+                    packed = self._pack(
+                        [d.astype(cdt) for d in dense], n_dev,
+                        cdt.type(-1), dtype=cdt,
+                    )
+                with pipeline.stage("h2d"):
+                    codes_d = _put(packed, sharding)
+                self._codes_cache.put(codes_key, codes_d)
+
+        # stacked per-member masks: one row per member that filters, one
+        # H2D for the whole stack.  Members without filters index None and
+        # feed the kernel mask=None — the bit-identical solo form.
+        mask_rows = []
+        mask_idx_of = {}
+        with self._phase("mask"):
+            for qi, query in enumerate(queries):
+                if not query.where_terms:
+                    continue
+                shard_masks = []
+                for table in tables:
+                    mask = ops.build_mask(table, query.where_terms)
+                    shard_masks.append(
+                        np.ones(int(table.nrows), dtype=bool)
+                        if mask is None else np.asarray(mask)
+                    )
+                mask_idx_of[qi] = len(mask_rows)
+                mask_rows.append(
+                    self._pack(shard_masks, n_dev, False, dtype=np.bool_)
+                )
+        masks_d = None
+        if mask_rows:
+            with self._phase("layout"), pipeline.stage("h2d"):
+                masks_d = _put(
+                    np.stack(mask_rows),
+                    NamedSharding(mesh, P(None, self.axis_name, None)),
+                )
+
+        with self._phase("layout"):
+            def build_packed(col):
+                for fut in prefetch.get(col, ()):
+                    fut.result()
+                with pipeline.stage("decode"):
+                    wire = (
+                        _wire_dtype(tables, col)
+                        or _stored_dtype(tables, col)
+                    )
+                    cols = [np.asarray(t.column_raw(col)) for t in tables]
+                    if wire is not None:
+                        cols = [c.astype(wire, copy=False) for c in cols]
+                    return self._pack(cols, n_dev, 0, dtype=wire)
+
+            missing = [
+                col
+                for col in union_cols
+                if (tables_key, "col", col, n_dev) not in self._hbm_cache
+            ]
+            futures = {}
+            use_pool = len(missing) > 1 and pipeline.pipeline_threads() > 1
+            missing_iter = iter(missing)
+
+            def submit_next():
+                for c in missing_iter:
+                    futures[c] = pipeline.submit(build_packed, c)
+                    return
+
+            if use_pool:
+                submit_next()
+            measures_d = []
+            for col in union_cols:
+                mkey = (tables_key, "col", col, n_dev)
+                arr = self._hbm_cache.get(mkey)
+                if arr is None:
+                    if col in futures:
+                        packed = futures.pop(col).result()
+                        submit_next()
+                    else:
+                        packed = build_packed(col)
+                    with pipeline.stage("h2d"):
+                        arr = _put(packed, sharding)
+                    self._hbm_cache.put(mkey, arr)
+                measures_d.append(arr)
+
+        slot_of = {col: i for i, col in enumerate(union_cols)}
+        sentinels = tuple(
+            np.iinfo(np.int64).min if k == "datetime" else None
+            for k in union_kinds
+        )
+        member_specs = tuple(
+            (
+                mask_idx_of.get(qi),
+                tuple(
+                    (slot_of[col], op)
+                    for col, op in zip(query.in_cols, query.ops)
+                ),
+            )
+            for qi, query in enumerate(queries)
+        )
+
+        with self._phase("aggregate"), pipeline.stage("kernel"):
+            n_prog = ops.program_bucket(n_groups)
+            # route label: on CPU the shared-scan kernel is the batched
+            # scatter family regardless of any hint; on accelerators the
+            # bundle runs per-member partial_tables dispatches (the
+            # batched form would be the emulated wide scatter — see
+            # ops.bundle_partial_tables), where the first member's
+            # predicted route speaks for the bundle
+            import jax as _jax
+
+            if _jax.default_backend() == "cpu":
+                self.last_effective_strategy = "scatter"
+            else:
+                first = queries[0]
+                self.last_effective_strategy = ops.kernel_route(
+                    strategy,
+                    tuple(measures_d[slot_of[c]] for c in first.in_cols),
+                    tuple(first.ops), int(codes_d.shape[1]), n_prog,
+                )
+            merged_members = _mesh_bundle_partials(
+                mesh, self.axis_name, n_prog, codes_d, masks_d,
+                tuple(measures_d), member_specs, sentinels,
+                strategy=strategy, merge_mode=merge_mode,
+            )
+            if n_prog != n_groups:
+                merged_members = jax.tree_util.tree_map(
+                    lambda a: a[..., :n_groups], merged_members
+                )
+
+        with self._phase("collect"), pipeline.stage("merge"):
+            out = []
+            for query, merged in zip(queries, merged_members):
+                member_kinds = [kind_of[c] for c in query.in_cols]
+                out.append(
+                    self._finish_collect(
+                        merged, merge_mode, int(n_dev), query, tables,
+                        combos, cards, key_values, member_kinds,
+                    )
+                )
+            return out
 
 
 def _pack_leaf(leaf):
@@ -1053,6 +1352,169 @@ def _mesh_program(mesh, axis, agg_ops, n_groups, in_dtypes, in_width, pack,
     from bqueryd_tpu.obs import profile as obsprofile
 
     return obsprofile.instrument("executor.mesh_program", jax.jit(fn)), spec
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_bundle_program(mesh, axis, n_groups, in_dtypes, in_width, pack,
+                         member_specs, null_sentinels, route=None,
+                         strategy=None, merge_mode="psum", n_masks=0):
+    """Build + cache the jitted shared-scan BUNDLE program for one bundle
+    shape.  The key carries everything that changes the trace: the static
+    per-member spec tuple (mask slot + (measure slot, op) pairs), the
+    stacked-mask count, the union measure dtypes, and the same route/merge
+    knobs as :func:`_mesh_program`.  The program emits one merged partial
+    table PER MEMBER (a tuple pytree): each member's emission is the same
+    :func:`ops.partial_tables` dispatch its solo program runs, under its
+    own stacked-mask row, and each member's cross-device merge is the same
+    collective the solo program traces — the whole bundle reduces in one
+    compiled dispatch."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bqueryd_tpu import ops
+    from bqueryd_tpu.parallel import devicemerge
+
+    n_dev = int(mesh.devices.size)
+    spec = {}
+
+    def merge_member(partials):
+        if merge_mode == devicemerge.MODE_DEVICE:
+            bucketized, span = ops.bucketize_partials(
+                partials, n_groups, n_dev
+            )
+            return devicemerge.scatter_merge_partials(
+                bucketized, axis, n_dev, span
+            )
+        if merge_mode == devicemerge.MODE_HOST:
+            return partials
+        return ops.psum_partials(partials, axis)
+
+    def body(codes_blk, masks_blk, measure_blks):
+        codes = codes_blk[0]
+        masks = None if masks_blk is None else masks_blk[:, 0, :]
+        per_col = tuple(m[0] for m in measure_blks)
+        members = ops.bundle_partial_tables(
+            codes, masks, per_col, member_specs, n_groups,
+            null_sentinels=null_sentinels, strategy=strategy,
+        )
+        merged = tuple(merge_member(partials) for partials in members)
+        if not pack:
+            return merged
+        leaves, treedef = jax.tree_util.tree_flatten(merged)
+        spec["treedef"] = treedef
+        spec["leaves"] = tuple(
+            (np.dtype(leaf.dtype), tuple(leaf.shape)) for leaf in leaves
+        )
+        import jax.numpy as jnp
+
+        return jnp.concatenate([_pack_leaf(leaf).ravel() for leaf in leaves])
+
+    n_measures = len(in_dtypes) - 1 - (1 if n_masks else 0)
+    if n_masks:
+        def block_fn(codes_blk, masks_blk, *measure_blks):
+            return body(codes_blk, masks_blk, measure_blks)
+
+        in_specs = (P(axis, None), P(None, axis, None)) + tuple(
+            [P(axis, None)] * n_measures
+        )
+    else:
+        def block_fn(codes_blk, *measure_blks):
+            return body(codes_blk, None, measure_blks)
+
+        in_specs = tuple([P(axis, None)] * (1 + n_measures))
+    out_spec = P() if merge_mode == devicemerge.MODE_PSUM else P(axis)
+    fn = _shard_map(
+        block_fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        check=False,
+    )
+    from bqueryd_tpu.obs import profile as obsprofile
+
+    return obsprofile.instrument(
+        "executor.mesh_bundle_program", jax.jit(fn)
+    ), spec
+
+
+def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
+                          member_specs, null_sentinels, strategy=None,
+                          merge_mode="psum"):
+    """Run the bundle program and return the per-member merged partials
+    tuple ON HOST (numpy leaves) — one packed fetch for the whole bundle
+    when packing is enabled, with a per-query fallback to per-leaf
+    ``device_get`` (no process latch: the single-query path owns the
+    packed-broken diagnosis).  Shapes follow :func:`_mesh_partials`:
+    ``device``/``psum`` leaves are ``[n_groups]`` per member, ``host``
+    leaves ``[n_dev, n_groups]`` for the hostmerge fallback."""
+    import jax
+
+    from bqueryd_tpu.parallel import devicemerge
+
+    n_dev = int(mesh.devices.size)
+    pack = packed_fetch_enabled() and not _packed_fetch_broken
+    in_dtypes = (
+        (str(codes_d.dtype),)
+        + ((str(masks_d.dtype),) if masks_d is not None else ())
+        + tuple(str(m.dtype) for m in measures_d)
+    )
+    n_masks = 0 if masks_d is None else int(masks_d.shape[0])
+    args = (
+        (codes_d,)
+        + ((masks_d,) if masks_d is not None else ())
+        + tuple(measures_d)
+    )
+
+    def run(pack_flag):
+        return _mesh_bundle_program(
+            mesh, axis, int(n_groups), in_dtypes, int(codes_d.shape[1]),
+            pack_flag, member_specs, null_sentinels,
+            route=_route_key(), strategy=strategy, merge_mode=merge_mode,
+            n_masks=n_masks,
+        )
+
+    def finish(merged, fetched):
+        if merge_mode == devicemerge.MODE_DEVICE:
+            merged = jax.tree_util.tree_map(
+                lambda a: a[: int(n_groups)], merged
+            )
+        elif merge_mode == devicemerge.MODE_HOST:
+            merged = jax.tree_util.tree_map(
+                lambda a: np.asarray(a).reshape(n_dev, int(n_groups)),
+                merged,
+            )
+        _record_merge_bytes(
+            merge_mode, fetched, n_dev, int(n_groups), merged
+        )
+        return merged
+
+    if pack:
+        try:
+            program, spec = run(True)
+            with _collective_guard():
+                flat = np.asarray(jax.device_get(program(*args)))
+        except Exception:
+            import logging
+
+            logging.getLogger("bqueryd_tpu").exception(
+                "packed bundle fetch failed; retrying via per-leaf "
+                "device_get"
+            )
+        else:
+            if merge_mode == devicemerge.MODE_PSUM:
+                merged = jax.tree_util.tree_unflatten(
+                    spec["treedef"], _unpack_host(flat, spec["leaves"])
+                )
+            else:
+                merged = _assemble_sharded(flat, spec, n_dev, merge_mode)
+            return finish(merged, flat.nbytes)
+    program, _spec = run(False)
+    with _collective_guard():
+        result = jax.device_get(program(*args))
+    fetched = sum(
+        np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(result)
+    )
+    return finish(result, fetched)
 
 
 #: set when the packed program failed to build/run on this backend (seen

@@ -15,7 +15,11 @@ Four pieces, all control-plane safe (no JAX, no pandas):
   recorded by workers, gossiped in WRMs, refined online
   (``BQUERYD_TPU_CALIB=0`` restores the pure heuristic);
 * :mod:`bqueryd_tpu.plan.admission` — bounded priority admission queue with
-  per-client quotas, deadlines, and explicit BUSY backpressure.
+  per-client quotas, deadlines, and explicit BUSY backpressure;
+* :mod:`bqueryd_tpu.plan.bundle`    — shared-scan multi-query fusion: the
+  admission micro-batch window (``BQUERYD_TPU_BATCH_WINDOW_MS``), the plan
+  compatibility signature, and the bundle fragments whole compatible groups
+  dispatch (and demultiplex) as one unit.
 
 ``BQUERYD_TPU_PLANNER=0`` disables plan-time pruning and strategy hints
 (queries revert to the static fan-out); admission limits are controlled by
@@ -54,6 +58,7 @@ from bqueryd_tpu.plan.strategy import (  # noqa: F401
     select_calibrated,
     select_for_group,
 )
+from bqueryd_tpu.plan import bundle  # noqa: F401
 from bqueryd_tpu.plan import calibrate  # noqa: F401
 
 

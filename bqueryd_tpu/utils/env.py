@@ -13,7 +13,7 @@ import os
 def env_num(name, default, cast=float):
     """The registered override when set and parseable, ``default``
     otherwise."""
-    # bqtpu: allow[config-dynamic-env-key] callers pass literal registered names: the controller timing knobs (DEAD_WORKER/DISPATCH/DISPATCH_HARD TIMEOUTs, MAX_DISPATCH_RETRIES, HEDGE_MS, REPLICA_FACTOR) and plan.admission's ADMIT_* trio; all in ENV_REGISTRY
+    # bqtpu: allow[config-dynamic-env-key] callers pass literal registered names: the controller timing knobs (DEAD_WORKER/DISPATCH/DISPATCH_HARD TIMEOUTs, MAX_DISPATCH_RETRIES, HEDGE_MS, REPLICA_FACTOR), plan.admission's ADMIT_* trio, and plan.bundle's BATCH_WINDOW_MS/BATCH_MAX; all in ENV_REGISTRY
     raw = os.environ.get(name)
     if raw in (None, ""):
         return default

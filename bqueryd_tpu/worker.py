@@ -1269,6 +1269,8 @@ class WorkerNode(WorkerBase):
             return self.execute_code(msg)
         if not msg.isa("groupby"):
             return super().handle_work(msg)
+        if msg.get("bundle"):
+            return self._handle_bundle(msg)
 
         from bqueryd_tpu import obs
         from bqueryd_tpu.models.query import GroupByQuery
@@ -1457,6 +1459,227 @@ class WorkerNode(WorkerBase):
             reply["merge_mode"] = merge_mode
         self.logger.debug("calc %s done: %s", filename, timer.as_dict())
         return reply
+
+    def _bundle_mesh_eligible(self, tables, queries):
+        """Mirror of the single-query ``_execute`` routing decision for a
+        whole bundle: the shared-scan mesh path runs when every member is
+        mergeable, the backend is healthy, and the row count clears the
+        host-kernel threshold (worst member's rate estimate wins)."""
+        from bqueryd_tpu.models.query import (
+            _host_ns_estimate,
+            host_kernel_rows,
+        )
+        from bqueryd_tpu.parallel.executor import MeshQueryExecutor
+
+        if devicehealth.backend_wedged():
+            return False
+        if not all(MeshQueryExecutor.supports(q) for q in queries):
+            return False
+        total_rows = sum(int(t.nrows) for t in tables)
+        try:
+            worst = max(
+                (
+                    _host_ns_estimate(t, q.agg_list, total_rows)
+                    for t in tables
+                    for q in queries
+                ),
+                default=None,
+            )
+        except Exception:
+            # an unestimable member (e.g. a column the shard doesn't have)
+            # routes the bundle to the per-member path, where the offender
+            # errors ALONE instead of failing its bundle-mates
+            return False
+        return total_rows > host_kernel_rows(worst)
+
+    def _handle_bundle(self, msg):
+        """Shared-scan bundle execution: one CalcMessage carrying several
+        compatible member queries (``plan.bundle``).  Scan work — open,
+        decode, align/factorize, uploads — happens once; each member keeps
+        its own identity: per-member result-cache keys, per-member deadline
+        enforcement (an expired member is dropped from the stack, not the
+        bundle), per-member error isolation on the fallback path.  The
+        reply demultiplexes through the ``bundle_members`` wire key: its
+        data frame is one pickled ``{"payloads": {member_id: bytes},
+        "errors": {member_id: text}}`` envelope."""
+        import pickle
+
+        from bqueryd_tpu import chaos, obs
+        from bqueryd_tpu.parallel.executor import _table_key
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        recorder = None
+        if obs.enabled():
+            ctx = obs.TraceContext.from_wire(msg.get_trace())
+            recorder = obs.SpanRecorder(
+                trace_id=ctx.trace_id if ctx else obs.new_id(16),
+                node=self.worker_id,
+                root_name="calc",
+                root_parent=ctx.span_id if ctx else None,
+            )
+        timer = PhaseTimer(recorder=recorder, span_names=obs.PHASE_SPAN_NAMES)
+        fragment = msg.get_from_binary("bundle")
+        members = bundlemod.bundle_to_queries(fragment)
+        strategy = bundlemod.fragment_strategy(fragment)
+        filename = msg.get("filename") or fragment.get("filenames")
+        filenames = filename if isinstance(filename, list) else [filename]
+        tables = []
+        with timer.phase("open"):
+            for name in filenames:
+                rootdir = os.path.join(self.data_dir, name)
+                if not os.path.exists(rootdir):
+                    raise ValueError(f"Path {rootdir} does not exist")
+                tables.append(self._open_table(rootdir))
+
+        cache = self.result_cache
+        tables_sig = tuple(_table_key(t) for t in tables)
+        payloads = {}      # member_id -> serialized ResultPayload bytes
+        errors = {}        # member_id -> failure text (member-only abort)
+        active = []        # (member_id, query) still needing execution
+        now = time.time()
+        for member_id, deadline, query in members:
+            if deadline is not None and float(deadline) <= now:
+                # the member's budget is gone: drop it from the stack, not
+                # the bundle — its bundle-mates keep their answers
+                errors[member_id] = (
+                    f"deadline exceeded "
+                    f"{now - float(deadline):.3f}s before execution"
+                )
+                continue
+            if cache is not None:
+                hit = cache.get((tables_sig, query.signature()))
+                if hit is not None:
+                    payloads[member_id] = hit
+                    continue
+            active.append((member_id, query))
+
+        results = {}
+        if active:
+            queries = [q for _mid, q in active]
+            mesh_payloads = None
+            if self._bundle_mesh_eligible(tables, queries):
+                import jax
+
+                from bqueryd_tpu import ops as ops_mod
+
+                try:
+                    mesh_payloads = self.mesh_executor_for_bundle(
+                        tables, queries, timer, strategy
+                    )
+                except chaos.TransientError:
+                    # a transient device fault fails the whole bundle over
+                    # to a replica holder — never silently degrades one
+                    # member
+                    raise
+                except (
+                    ops_mod.CompositeOverflow,
+                    jax.errors.JaxRuntimeError,
+                ) as exc:
+                    self.logger.warning(
+                        "bundle mesh path failed (%s); retrying members "
+                        "via the per-member engine path",
+                        (str(exc).splitlines() or [""])[0][:200],
+                    )
+                except ValueError as exc:
+                    # a member-shape rejection (e.g. datetime sum) must
+                    # isolate to the per-member path, where the offender
+                    # errors alone
+                    self.logger.info(
+                        "bundle mesh path rejected (%s); running members "
+                        "individually", exc,
+                    )
+            if mesh_payloads is not None:
+                results = dict(zip((m for m, _q in active), mesh_payloads))
+            else:
+                for member_id, query in active:
+                    try:
+                        results[member_id] = self._execute(
+                            tables, query, timer, strategy=strategy
+                        )
+                    except chaos.TransientError:
+                        raise  # whole-bundle failover, as above
+                    except Exception as exc:
+                        self.logger.exception(
+                            "bundle member %s failed", member_id
+                        )
+                        errors[member_id] = (
+                            f"{type(exc).__name__}: {exc}"
+                        )
+
+        with timer.phase("serialize"):
+            for member_id, payload in results.items():
+                data = payload.to_bytes()
+                payloads[member_id] = data
+                if cache is not None and len(data) <= cache.max_bytes // 8:
+                    query = next(
+                        q for mid, q in active if mid == member_id
+                    )
+                    cache.put(
+                        (tables_sig, query.signature()), data,
+                        nbytes=len(data),
+                    )
+            data = pickle.dumps(
+                {"v": 1, "payloads": payloads, "errors": errors},
+                protocol=4,
+            )
+        if obs.enabled():
+            self.reply_bytes.observe(len(data))
+        # same memory backstop as the solo reply path — a bundle envelope
+        # is ~N solo payloads in one message, the LARGEST reply this
+        # worker produces, so the cache shed matters here most
+        if self.memory_limit_mb and sys.getsizeof(data) > (
+            self.memory_limit_mb * (1 << 20) // 32
+        ):
+            self._shed_caches()
+        reply = msg.copy()
+        reply["data"] = data
+        reply["bundle_members"] = [mid for mid, _dl, _q in members]
+        reply["phase_timings"] = timer.as_dict()
+        if recorder is not None:
+            reply["spans"] = recorder.export()
+            # one CalcMessage executed, whatever its member count (the
+            # counter's help text promise); member volume is the
+            # controller's plan_bundled_queries
+            self.groupby_queries.inc()
+            self.groupby_seconds.observe(timer.total())
+        # route/merge visibility mirrors the single-query reply: the last
+        # executed route speaks for the bundle (members share one shape);
+        # "cached" only when cache hits actually served members — a bundle
+        # whose members ALL errored pre-execution served nothing
+        effective = (
+            getattr(self, "_last_effective_strategy", None)
+            if active
+            else ("cached" if payloads else None)
+        )
+        merge_mode = (
+            getattr(self, "_last_merge_mode", None) if active else None
+        )
+        if effective is not None:
+            reply["effective_strategy"] = effective
+        if merge_mode is not None:
+            reply["merge_mode"] = merge_mode
+        self.logger.debug(
+            "bundle calc %s done: %d members (%d cached/served, %d "
+            "errored): %s",
+            filename, len(members),
+            len(payloads) - len(results), len(errors), timer.as_dict(),
+        )
+        return reply
+
+    def mesh_executor_for_bundle(self, tables, queries, timer, strategy):
+        """Run the shared-scan mesh path for a bundle (seam kept separate
+        so tests can spy on it): returns per-member ResultPayloads."""
+        self._last_effective_strategy = None
+        self._last_merge_mode = None
+        self.mesh_executor.timer = timer
+        payloads = self.mesh_executor.execute_bundle(
+            tables, queries, strategy=strategy
+        )
+        self._last_effective_strategy = (
+            self.mesh_executor.last_effective_strategy
+        )
+        self._last_merge_mode = self.mesh_executor.last_merge_mode
+        return payloads
 
     def execute_code(self, msg):
         """Import a dotted function path and call it — the reference's

@@ -1878,3 +1878,79 @@ def test_hedged_transient_fault_defers_to_outstanding_duplicate(
         assert not controller.inflight
     finally:
         _stop([controller] + workers, threads)
+
+
+def test_bundle_shared_scan_fails_over_as_one_unit(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """Bundle x PR-8 failover: two distinct-but-compatible concurrent
+    queries fuse into ONE shared-scan bundle inside the admission window;
+    a chaos transient fault (wedge -> DeviceBusyError) on the first holder
+    fails the WHOLE bundle over to the replica holder — both members get
+    bit-exact answers, neither aborts, and no member is executed twice for
+    one successful attempt (one bundle token end to end)."""
+    from bqueryd_tpu import chaos
+    from bqueryd_tpu.rpc import RPC
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url, df_seed=17
+    )
+    monkeypatch.setenv("BQUERYD_TPU_BATCH_WINDOW_MS", "400")
+    try:
+        chaos.arm({
+            "seed": 9,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "wedge",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        # distinct signatures (different filter conjunctions over the full
+        # value range), identical answers: both cover every row
+        lo = -(2**41)
+        queries = [
+            (list(shards), ["g"], [["v", "sum", "s"]], [["v", ">", lo]]),
+            (list(shards), ["g"], [["v", "sum", "s"]], [["v", ">=", lo]]),
+        ]
+        results, errors = {}, {}
+
+        def ask(i):
+            try:
+                rpc = RPC(
+                    coordination_url=mem_store_url, timeout=60,
+                    loglevel=logging.WARNING,
+                )
+                df = rpc.groupby(*queries[i])
+                results[i] = dict(zip(df["g"].tolist(), df["s"].tolist()))
+            except Exception as exc:  # noqa: BLE001
+                errors[i] = exc
+
+        askers = [
+            threading.Thread(target=ask, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in askers:
+            t.start()
+        for t in askers:
+            t.join(90)
+        assert not errors, errors
+        assert results[0] == expected
+        assert results[1] == expected
+        # the two queries rode ONE bundle...
+        assert controller.counters["plan_bundles"] >= 1
+        assert controller.counters["plan_bundled_queries"] >= 2
+        # ...which failed over as one unit on the transient fault
+        assert controller.counters["transient_faults"] >= 1
+        assert controller.counters["failover_dispatches"] >= 1
+        # a transient fault never culls: both holders still registered,
+        # exactly one latched its chaos wedge
+        assert len(controller.worker_map) == 2
+        assert sum(1 for w in workers if w._chaos_wedged) == 1
+        wait_until(
+            lambda: not controller.inflight and not controller.rpc_segments,
+            desc="bundle settled after failover",
+        )
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)

@@ -825,3 +825,57 @@ def test_wrm_shard_stats_absorbed(controller):
     )
     controller.handle_worker(b"w9", wrm2)
     assert "a.bcolzs" not in controller.shard_stats
+
+
+def test_supersede_drops_staged_window_plan(controller, monkeypatch):
+    """A plan still STAGED in the admission micro-batch window when its
+    identity sends a DIFFERENT query must be dropped before the flush can
+    launch it — a launched stale run would queue a mis-pairing reply for
+    the identity's next request (the same contract as superseding an
+    active run, one stage earlier)."""
+    register(controller, "w1", ["a.bcolzs", "b.bcolzs"])
+    monkeypatch.setenv("BQUERYD_TPU_BATCH_WINDOW_MS", "60000")
+    controller.rpc_groupby(groupby_msg(["a.bcolzs"], token="aa"))
+    assert len(controller._pending_window) == 1
+    assert not controller.rpc_segments  # staged, not launched
+    controller.rpc_groupby(
+        groupby_msg(["b.bcolzs"], where=[["k", ">", 1]], token="aa")
+    )
+    assert controller.counters["admission_superseded"] == 1
+    # only the NEW query remains staged, and the identity holds ONE ticket
+    (staged_entry,) = controller._pending_window
+    assert staged_entry[1].filenames == ["b.bcolzs"]
+    assert controller.admission.stats()["active"] == 1
+    controller._flush_window(force=True)
+    (segment,) = controller.rpc_segments.values()
+    assert segment["filenames"] == ["b.bcolzs"]
+    # no reply was emitted for the abandoned staged plan
+    assert controller._replies == []
+
+
+def test_bundle_reply_without_members_aborts_not_misdelivers(
+    controller, monkeypatch
+):
+    """A bundle answered WITHOUT bundle_members (a pre-PR-9 worker ran only
+    the positional params) must abort every member with the mixed-version
+    error — falling through to the shared-dispatch sink would hand one
+    member's payload to every member as ok=True."""
+    register(controller, "w1", ["a.bcolzs"])
+    monkeypatch.setenv("BQUERYD_TPU_BATCH_WINDOW_MS", "60000")
+    controller.rpc_groupby(groupby_msg(["a.bcolzs"], token="aa"))
+    controller.rpc_groupby(
+        groupby_msg(["a.bcolzs"], where=[["k", ">", 1]], token="bb")
+    )
+    controller._flush_window(force=True)
+    assert controller.counters["plan_bundles"] == 1
+    (msg,) = queued(controller)
+    assert msg.get("bundle") and msg.get("_bundle_parents")
+    reply = CalcMessage(dict(msg))
+    reply["data"] = b"member0-payload"  # no bundle_members key
+    controller.process_worker_result(reply)
+    assert sorted(c for c, _ in controller._replies) == ["aa", "bb"]
+    for _client, payload in controller._replies:
+        envelope = pickle.loads(payload)
+        assert envelope["ok"] is False
+        assert "BQUERYD_TPU_BATCH_WINDOW_MS=0" in envelope["error"]
+    assert not controller.rpc_segments
