@@ -1510,6 +1510,189 @@ def main():
                     flush=True,
                 )
 
+        # slo/autopsy: per-query critical-path attribution on the sharded
+        # config — coverage >= 95% of the wall (BENCH_SLO_GATE=0 records
+        # without asserting), an rpc.autopsy round trip including the
+        # client_deserialize fold, the deadline-margin histogram, the
+        # combined spans+attribution overhead vs the 2% budget, and one run
+        # under the PR-8 kill-worker chaos plan whose autopsy must carry
+        # retry/backoff segments that sum consistently with the wall
+        slo_detail = {}
+        if (
+            os.environ.get("BENCH_SLO", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            from bqueryd_tpu import chaos as chaos_mod
+            from bqueryd_tpu import obs as obs_mod
+            from bqueryd_tpu.obs import slo as slo_mod
+
+            gate_on = os.environ.get("BENCH_SLO_GATE", "1") == "1"
+            try:
+                controller_node = nodes[0]
+                files, gcols, aggs, where = config_query(HEADLINE, names)
+                coverages, sample = [], None
+                for _ in range(max(REPEATS, 3)):
+                    rpc.groupby(files, gcols, aggs, where, deadline=120)
+                    record = rpc.autopsy(rpc.last_trace_id)
+                    assert record is not None, "autopsy round trip failed"
+                    # the client fold extended the record with its own
+                    # deserialize wall
+                    assert "client_deserialize" in record["segments"]
+                    total = (
+                        sum(record["segments"].values())
+                        + record["unattributed_s"]
+                    )
+                    assert abs(total - record["wall_s"]) < 1e-3, (
+                        "attribution segments must sum to the wall"
+                    )
+                    coverages.append(record["coverage"])
+                    sample = record
+                slo_detail["coverage_per_run"] = [
+                    round(c, 4) for c in coverages
+                ]
+                slo_detail["coverage_min"] = round(min(coverages), 4)
+                slo_detail["sample_autopsy"] = sample
+                # deadline-margin histogram: the deadline=120 queries above
+                # landed in the default class with positive margins
+                margin_hist = controller_node.slo._hist[
+                    slo_mod.DEFAULT_CLASS
+                ]
+                slo_detail["margin_histogram"] = margin_hist.snapshot()
+                slo_detail["margin_observations"] = margin_hist.count
+                slo_detail["slo_snapshot"] = controller_node.slo.snapshot()
+                slo_detail["timeline_entries"] = len(
+                    controller_node.timeline_ring
+                )
+
+                # attribution microcost on the REAL sample timeline (same
+                # method as the obs gate: deterministic per-query work as a
+                # fraction of the measured wall), combined with the span/
+                # histogram cost already measured above — the 2% budget now
+                # covers the whole enabled path, attribution included
+                sample_timeline = controller_node.trace_store.get(
+                    sample["trace_id"]
+                ) or {"spans": []}
+                scratch_slo = slo_mod.SLOTracker(obs_mod.MetricsRegistry())
+                K = 2000
+                t0 = time.perf_counter()
+                for _ in range(K):
+                    slo_mod.attribute(sample_timeline)
+                    scratch_slo.record("default", 0.5)
+                attrib_s = (time.perf_counter() - t0) / K
+                headline_wall = (
+                    obs_detail.get("metrics_on_wall_s") or sample["wall_s"]
+                )
+                attrib_pct = attrib_s / headline_wall * 100.0
+                combined_pct = attrib_pct + (
+                    obs_detail.get("overhead_pct") or 0.0
+                )
+                slo_detail["attribution_cost_ms"] = round(attrib_s * 1e3, 3)
+                slo_detail["attribution_overhead_pct"] = round(attrib_pct, 3)
+                slo_detail["combined_overhead_pct"] = round(combined_pct, 3)
+                slo_detail["combined_within_2pct"] = combined_pct <= 2.0
+
+                # chaos leg: kill-worker over a fresh 2-replica cluster —
+                # the recovery (failed attempt wait + backoff + failover
+                # dispatch) must be ATTRIBUTED, not mystery wall
+                chaos_rpc = controller2 = None
+                nodes2, threads2 = [], []
+                try:
+                    (
+                        chaos_rpc, controller2, _workers2, nodes2, threads2,
+                    ) = _chaos_cluster(n_workers=2)
+                    chaos_mod.arm({
+                        "seed": 81,
+                        "faults": [{
+                            "site": "worker.execute",
+                            "action": "die_after_ack",
+                            "match": {"verb": "groupby"},
+                            "times": 1,
+                        }],
+                    })
+                    chaos_rpc.groupby(files, gcols, aggs, where)
+                    chaos_record = chaos_rpc.autopsy(
+                        chaos_rpc.last_trace_id
+                    )
+                    chaos_mod.disarm()
+                    assert chaos_record is not None, (
+                        "chaos-leg autopsy round trip failed"
+                    )
+                    total = (
+                        sum(chaos_record["segments"].values())
+                        + chaos_record["unattributed_s"]
+                    )
+                    slo_detail["chaos_kill_worker"] = {
+                        "ok": chaos_record["ok"],
+                        "wall_s": chaos_record["wall_s"],
+                        "coverage": chaos_record["coverage"],
+                        "segments": chaos_record["segments"],
+                        "attempts": len(chaos_record["attempts"]),
+                        "retry_backoff_s": chaos_record["segments"].get(
+                            "retry_backoff", 0.0
+                        ),
+                        "sum_consistent": abs(
+                            total - chaos_record["wall_s"]
+                        ) < 1e-3,
+                        "failover_dispatches": controller2.counters[
+                            "failover_dispatches"
+                        ],
+                    }
+                finally:
+                    chaos_mod.disarm()
+                    for node in nodes2:
+                        node.running = False
+                    for t in threads2:
+                        t.join(timeout=5)
+                    if chaos_rpc is not None:
+                        chaos_rpc._close_socket()
+
+                print(
+                    f"[bench] slo: coverage min "
+                    f"{slo_detail['coverage_min']:.3f}, attribution "
+                    f"{attrib_s * 1e3:.2f} ms/query "
+                    f"(combined {combined_pct:.3f}% of wall), chaos "
+                    f"kill-worker coverage "
+                    f"{slo_detail['chaos_kill_worker']['coverage']:.3f} "
+                    f"with {slo_detail['chaos_kill_worker']['attempts']} "
+                    "attempts",
+                    file=sys.stderr, flush=True,
+                )
+                if gate_on:
+                    assert slo_detail["coverage_min"] >= 0.95, (
+                        f"attribution coverage {slo_detail['coverage_min']} "
+                        "below the 0.95 contract on the sharded config"
+                    )
+                    assert slo_detail["margin_observations"] > 0, (
+                        "deadline-margin histogram never populated"
+                    )
+                    assert combined_pct <= 2.0, (
+                        f"obs + attribution cost {combined_pct:.2f}% of the "
+                        "wall (budget: 2%)"
+                    )
+                    ck = slo_detail["chaos_kill_worker"]
+                    assert ck["ok"], "chaos-leg query failed"
+                    assert ck["sum_consistent"], (
+                        "chaos autopsy segments do not sum to the wall"
+                    )
+                    assert ck["retry_backoff_s"] > 0, (
+                        "kill-worker recovery shows no retry_backoff "
+                        "segment"
+                    )
+                    assert ck["attempts"] >= 2, (
+                        "kill-worker autopsy lists no failover attempt"
+                    )
+            except Exception as exc:
+                if gate_on:
+                    # same contract as the armed chaos gate: a setup crash
+                    # (cluster bring-up, malformed autopsy) must fail the
+                    # armed gate, not record slo={} and read as green
+                    raise
+                print(
+                    f"[bench] slo section failed: {exc!r}",
+                    file=sys.stderr, flush=True,
+                )
+
         # profiling: the compile-side story of the whole bench run — the
         # program registry (per-shape compiles, jit-cache reuse, HLO
         # cost_analysis FLOPs/bytes) plus the persistent compile cache's
@@ -2322,6 +2505,10 @@ def main():
             # registry snapshots bracketing the headline walls + the
             # metrics-hot-path overhead gate + a sample trace waterfall
             "observability": obs_detail,
+            # critical-path attribution coverage (>=95% gate), the sample
+            # autopsy, deadline-margin histogram, combined spans +
+            # attribution overhead, and the kill-worker chaos autopsy
+            "slo": slo_detail,
             # compile-cache hit rates + the per-shape program registry with
             # cost_analysis FLOPs (obs.profile)
             "profiling": profiling_detail,
@@ -2396,6 +2583,10 @@ def main():
                             planner_detail.get(HEADLINE) or {}
                         ).get("chosen_strategy"),
                         "obs_overhead_pct": obs_detail.get("overhead_pct"),
+                        "slo_coverage_min": slo_detail.get("coverage_min"),
+                        "slo_combined_overhead_pct": slo_detail.get(
+                            "combined_overhead_pct"
+                        ),
                         "pipeline_speedup": pipeline_detail.get(
                             "pipeline_speedup"
                         ),

@@ -29,6 +29,10 @@ jit-purity            host impurity and cache-key hazards inside jitted
                       compile-profile counters via ``jit-uninstrumented``
 wire-schema           envelope key literals in controller/worker/rpc vs the
                       schemas declared in ``messages.py`` (:mod:`.wire`)
+span-schema           literal span/phase sites vs ``messages.SPAN_SCHEMA``
+                      and the attribution map in ``obs.slo``
+                      (:mod:`.spans`) — a new dispatch path cannot ship
+                      spans that ``rpc.autopsy`` drops into unattributed
 metric-lint /         static twins of the PR 2/3 runtime metric lints
 metric-readme         (:mod:`.metricslint`); the runtime entry points in
                       ``obs.metrics`` keep working unchanged
@@ -57,6 +61,7 @@ def default_analyzers():
         MetricReadmeAnalyzer,
     )
     from bqueryd_tpu.analysis.purity import JitPurityAnalyzer
+    from bqueryd_tpu.analysis.spans import SpanSchemaAnalyzer
     from bqueryd_tpu.analysis.wire import WireSchemaAnalyzer
 
     return [
@@ -64,6 +69,7 @@ def default_analyzers():
         LockDisciplineAnalyzer(),
         JitPurityAnalyzer(),
         WireSchemaAnalyzer(),
+        SpanSchemaAnalyzer(),
         MetricNameAnalyzer(),
         MetricReadmeAnalyzer(),
     ]

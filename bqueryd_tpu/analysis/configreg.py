@@ -230,6 +230,19 @@ ENV_REGISTRY = {
            "slow-query log threshold (0 records everything)"),
         _v("SLOW_QUERY_BYTES", "int", "4 MiB",
            "byte cap on the slow-query ring"),
+        _v("SLO_CLASSES", "str", "",
+           "SLO class table: comma list of name:target_s[:objective] "
+           "(e.g. interactive:0.5:0.999,batch:30); a default class "
+           "(2 s, 0.99) always exists — clients pick theirs via "
+           "RPC(slo_class=...)", read_time="import"),
+        _v("TIMELINE_INTERVAL_S", "float", "10",
+           "rpc.timeline() snapshot period in SECONDS (<=0 disables the "
+           "ring; distinct from the _ENTRIES count cap)",
+           related=("TIMELINE_ENTRIES",)),
+        _v("TIMELINE_ENTRIES", "int", "360",
+           "ENTRY-COUNT cap on the rpc.timeline() snapshot ring (newest "
+           "kept; distinct from the _INTERVAL_S period)",
+           read_time="import", related=("TIMELINE_INTERVAL_S",)),
         _v("LOG_JSON", "flag", "0",
            "structured JSON log lines with trace correlation ids"),
         _v("COMPILE_PROFILE", "flag", "1",

@@ -101,6 +101,26 @@ class Suppression:
         return "*" in self.rules or rule in self.rules
 
 
+def module_literal(tree, name):
+    """The literal value of a module-level ``name = <literal>`` assignment
+    in a parsed tree, or None (absent, non-literal, or unparseable).  The
+    shared extraction for analyzers that diff code against a declared
+    schema constant (wire envelope schemas, span schemas) — one walker
+    instead of a per-analyzer copy."""
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            try:
+                return ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                return None
+    return None
+
+
 def _comment_lines(text):
     """(lineno, comment_text) for every real COMMENT token — tokenizing (not
     regexing raw lines) keeps pragma syntax mentioned in docstrings from

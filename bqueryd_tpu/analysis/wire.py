@@ -53,21 +53,16 @@ def _schema(project):
     the synthetic-project case in tests."""
     sf = project.file(f"{project.package}/messages.py")
     if sf is not None and sf.tree is not None:
+        from bqueryd_tpu.analysis.core import module_literal
+
         found = {}
-        for node in sf.tree.body:
-            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-                continue
-            target = node.targets[0]
-            if isinstance(target, ast.Name) and target.id in (
-                "ENVELOPE_SCHEMA", "RESULT_ENVELOPE_SCHEMA",
-                "WIRE_ONE_SIDED_OK",
-            ):
-                try:
-                    value = ast.literal_eval(node.value)
-                except (ValueError, SyntaxError):
-                    continue
-                if isinstance(value, dict):
-                    found[target.id] = value
+        for name in (
+            "ENVELOPE_SCHEMA", "RESULT_ENVELOPE_SCHEMA",
+            "WIRE_ONE_SIDED_OK",
+        ):
+            value = module_literal(sf.tree, name)
+            if isinstance(value, dict):
+                found[name] = value
         if "ENVELOPE_SCHEMA" in found:
             declared = dict(found.get("ENVELOPE_SCHEMA", {}))
             declared.update(found.get("RESULT_ENVELOPE_SCHEMA", {}))

@@ -74,6 +74,7 @@ CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
     "download", "readfile", "execute_code", "sleep", "groupby",
     "trace", "metrics", "slow_queries", "health", "debug_bundle",
+    "autopsy", "timeline",
 )
 
 #: help text for every controller counter — the spec the registry-backed
@@ -308,6 +309,12 @@ class ControllerNode:
         self.admission.wait_observer = self._observe_admission_wait
         self.trace_store = obs.TraceStore()
         self.slow_queries = obs.SlowQueryLog()
+        # SLO accounting (obs.slo): per-client-class deadline-margin
+        # histograms + burn-rate gauges, fed by every finished groupby in
+        # _finalize_query_obs; the timeline ring snapshots the registry
+        # periodically behind rpc.timeline() for regression spotting
+        self.slo = obs.slo.SLOTracker(self.metrics)
+        self.timeline_ring = obs.slo.SnapshotTimeline()
         self._worker_metrics = {}     # worker_id -> last histogram snapshot
         self._worker_metrics_rev = 0  # bumped on absorb/remove (cache key)
         self._worker_hist_cache = (-1, None)  # (rev, merged aggregate)
@@ -474,6 +481,10 @@ class ControllerNode:
         if now - self.last_heartbeat < self.heartbeat_interval:
             return
         self.last_heartbeat = now
+        # controller timeline ring: one bounded registry snapshot per
+        # BQUERYD_TPU_TIMELINE_INTERVAL_S (the ring paces itself; <=0
+        # disables), served by rpc.timeline()
+        self.timeline_ring.maybe_snapshot(self._timeline_snapshot, now=now)
         self.store.sadd(bqueryd_tpu.REDIS_SET_KEY, self.address)
         current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
         for addr in current:
@@ -972,28 +983,49 @@ class ControllerNode:
                 "retries": msg.get("_retries", 0),
             }
 
-    def _record_dispatch_span(self, msg, worker_id):
+    def _record_dispatch_span(self, msg, worker_id, hedge=False):
         """One "dispatch" span per successful send: queue-entry -> send, its
         span_id the CalcMessage's trace hop (the worker's calc span parents
         to it).  Recorded into EVERY live subscriber segment so shared
-        dispatches appear on each joined query's timeline."""
+        dispatches appear on each joined query's timeline.  Tags carry the
+        attempt metadata the attribution layer reads: retry count, the
+        charged backoff window (carved out as a retry_backoff segment),
+        failover exclusions, and the hedge flag for duplicate dispatches."""
         from bqueryd_tpu import obs
 
         wire = msg.get_trace()
         queued_ts = msg.get("_dispatch_queued_ts")
         if not wire or queued_ts is None or not obs.enabled():
             return
-        span = obs.make_span(
-            wire["trace_id"], "dispatch", queued_ts,
-            max(time.time() - float(queued_ts), 0.0),
-            span_id=wire["span_id"],
-            parent_span_id=wire.get("parent_span_id"),
-            node=self.address,
-            tags={
+        if hedge:
+            # the hedge dispatched NOW with no backoff of its own: the
+            # original attempt's retry/backoff/exclusion tags must not
+            # bleed onto its marker (they would read as hedge delay)
+            tags = {"worker": worker_id, "hedge": True}
+        else:
+            tags = {
                 "worker": worker_id,
                 "filename": str(msg.get("filename")),
                 "retries": msg.get("_retries", 0),
-            },
+            }
+            backoff_s = msg.get("_backoff_s")
+            if backoff_s:
+                tags["backoff_s"] = backoff_s
+            excluded = msg.get("_excluded_workers")
+            if excluded:
+                tags["excluded"] = list(excluded)
+        now = time.time()
+        span = obs.make_span(
+            wire["trace_id"], "dispatch",
+            now if hedge else queued_ts,
+            0.0 if hedge else max(now - float(queued_ts), 0.0),
+            # a hedge duplicates the original attempt's trace hop: its span
+            # gets its own id (make_span mints one when None) so both
+            # attempts stay distinct on the timeline
+            span_id=None if hedge else wire["span_id"],
+            parent_span_id=wire.get("parent_span_id"),
+            node=self.address,
+            tags=tags,
         )
         for parent in self._work_parents(msg):
             segment = self.rpc_segments.get(parent)
@@ -1216,6 +1248,9 @@ class ControllerNode:
             entry["hedged"] = target
             entry["hedged_at"] = now
             self._mark_hedged(token, now)
+            # the duplicate attempt lands on the timeline too (tagged
+            # hedge=True so attribution lists it beside the original)
+            self._record_dispatch_span(msg, target, hedge=True)
             if target in self.worker_map:
                 self.worker_map[target]["busy"] = True
                 self.worker_map[target]["last_seen"] = now
@@ -1240,10 +1275,36 @@ class ControllerNode:
 
     def _requeue(self, entry, charge_retry=True, failed_worker=None,
                  reason=None):
+        from bqueryd_tpu import obs
+
         msg = entry["msg"]
         retries = entry.get("retries", 0)
         if failed_worker is None:
             failed_worker = entry.get("worker")
+        # the failed attempt's in-flight window becomes its own dispatch
+        # span (tagged with the failure): a shard that sat 1.5 s on a dead
+        # worker must autopsy as dispatch wait on THAT worker, not as
+        # unattributed wall
+        sent_at = entry.get("sent_at")
+        wire = msg.get_trace()
+        if sent_at is not None and wire and obs.enabled():
+            span = obs.make_span(
+                wire["trace_id"], "dispatch", sent_at,
+                max(time.time() - float(sent_at), 0.0),
+                parent_span_id=wire.get("parent_span_id"),
+                node=self.address,
+                tags={
+                    "worker": failed_worker,
+                    "retries": retries,
+                    "failed": str(
+                        reason or "worker lost or dispatch timed out"
+                    )[:120],
+                },
+            )
+            for parent in self._work_parents(msg):
+                segment = self.rpc_segments.get(parent)
+                if segment is not None and segment.get("obs"):
+                    segment["obs"]["spans"].append(span)
         # per-attempt forensic history rides the message (bounded by the
         # retry budget); the structured exhaustion envelope surfaces it so
         # a client sees WHERE its query died instead of timing out blind
@@ -1278,7 +1339,11 @@ class ControllerNode:
         if charge_retry and failed_worker:
             self.counters["failover_dispatches"] += 1
         msg["_retries"] = retries + 1 if charge_retry else retries
-        msg["_not_before"] = time.time() + self._retry_backoff(msg, retries)
+        backoff_s = self._retry_backoff(msg, retries)
+        msg["_not_before"] = time.time() + backoff_s
+        # the charged backoff rides the message so the attempt's dispatch
+        # span can tag it — attribution carves it out as retry_backoff
+        msg["_backoff_s"] = round(backoff_s, 6)
         # each dispatch ATTEMPT is its own trace hop: a fresh span_id (a
         # slow-but-alive first worker's calc span keeps parenting to the
         # original attempt's recorded span) and a fresh queue-entry clock
@@ -1542,8 +1607,20 @@ class ControllerNode:
                     return
                 elif hedged:
                     self._mark_hedged(token, time.time())  # loser still due
+                    # forensic outcome events (rare, never gated): the
+                    # debug-bundle timeline must explain every hedge's
+                    # win/loss, not just that one was issued
                     if worker_id == hedged:
                         self.counters["hedge_wins"] += 1
+                        self.flight.record(
+                            "hedge_win",
+                            token=token, winner=worker_id, loser=assigned,
+                        )
+                    else:
+                        self.flight.record(
+                            "hedge_loss",
+                            token=token, winner=worker_id, loser=hedged,
+                        )
                     # the pop above destroyed the token's inflight entry,
                     # which was also the hard-timeout reclaim handle on the
                     # side that has NOT replied yet — keep one, or a wedged
@@ -1589,11 +1666,72 @@ class ControllerNode:
                     self.counters["duplicate_replies"] += 1
                 self.process_worker_result(msg, None)
 
+    def _record_inflight_span(self, msg, entry):
+        """The send→reply window as a dispatch span (tag ``wait``): worker
+        spans carve the actual execution out of it at higher sweep
+        priority, so what this span surfaces in an autopsy is the wire /
+        poll-loop transit the controller cannot otherwise see — without
+        it, a fast query's coverage is eaten by gaps no node owns."""
+        from bqueryd_tpu import obs
+
+        wire = msg.get_trace()
+        sent_at = (entry or {}).get("sent_at")
+        if not wire or sent_at is None or not obs.enabled():
+            return
+        now = time.time()
+        new_spans = [
+            obs.make_span(
+                wire["trace_id"], "dispatch", sent_at,
+                max(now - float(sent_at), 0.0),
+                parent_span_id=wire.get("parent_span_id"),
+                node=self.address,
+                tags={
+                    "worker": entry.get("worker"),
+                    "retries": entry.get("retries", 0),
+                    # attribution charges the uncovered remainder to the
+                    # dispatch segment but keeps it out of the attempts
+                    # list (the queue-entry span already represents the
+                    # attempt)
+                    "wait": True,
+                },
+            )
+        ]
+        hedged_at = entry.get("hedged_at")
+        if entry.get("hedged") and hedged_at is not None:
+            # the hedge duplicate's racing window (hedge dispatch → this
+            # reply): surfaces as the hedge_dispatch segment — how long
+            # the query's tail was spent racing two holders.  wait=True
+            # keeps it out of the attempts list (maybe_hedge's marker
+            # span already lists the hedge attempt)
+            new_spans.append(
+                obs.make_span(
+                    wire["trace_id"], "dispatch", hedged_at,
+                    max(now - float(hedged_at), 0.0),
+                    parent_span_id=wire.get("parent_span_id"),
+                    node=self.address,
+                    tags={
+                        "worker": entry.get("hedged"),
+                        "hedge": True,
+                        "wait": True,
+                    },
+                )
+            )
+        for parent in self._work_parents(msg):
+            segment = self.rpc_segments.get(parent)
+            if segment is not None and segment.get("obs"):
+                segment["obs"]["spans"].extend(new_spans)
+
     # -- results sink ------------------------------------------------------
     def process_worker_result(self, msg, entry=None):
         parent = msg.get("parent_token")
         token = msg.get("token")
         subscribers = self._work_subscribers.get(token)
+        if entry is not None and not (
+            msg.isa(ErrorMessage) and msg.get("transient")
+        ):
+            # the transient-fault path records its own (failed-tagged)
+            # in-flight span inside _requeue
+            self._record_inflight_span(msg, entry)
         if parent is None and not subscribers:
             # single-segment RPC (execute_code, sleep, readfile): a binary
             # data frame is folded into the JSON reply as base64
@@ -1710,6 +1848,8 @@ class ControllerNode:
         errored/expired member aborts ITS parent only; members whose
         parents aborted earlier (supersede, deadline) are skipped; the
         others complete normally."""
+        from bqueryd_tpu import obs
+
         token = msg.get("token")
         data = msg.get("data") or b""
         # payload bytes over the wire, once per reply (the controller-side
@@ -1724,11 +1864,27 @@ class ControllerNode:
             return
         member_payloads = envelope.get("payloads") or {}
         member_errors = envelope.get("errors") or {}
+        # per-member segment shares (messages.py `member_shares`): the
+        # fraction of the bundle's shared scan each member is accountable
+        # for — shared phase timings are scaled by it so a slow BUNDLE
+        # lands each member in the slow-query ring (and its autopsy) with
+        # ITS share of the wall, not the whole bundle's; pre-PR-10 workers
+        # ship no shares and the timings pass through unscaled
+        member_shares = msg.get("member_shares")
+        if not isinstance(member_shares, dict):
+            member_shares = {}
         filename = msg.get("filename")
         key = tuple(filename) if isinstance(filename, list) else (filename,)
         delivered = False
         counted_duplicate = False
         for member_id, parent in bundle_parents.items():
+            # per-member demux clock: a member's span must cover ITS slice
+            # of the demultiplex only — measured from iteration start to
+            # span append, so an earlier member's completion work (merge,
+            # attribution, reply — it runs inside _maybe_complete_segment)
+            # can never inflate a later member's bundle_demux segment
+            member_start_ts = time.time()
+            member_clock = time.perf_counter()
             segment = self.rpc_segments.get(parent)
             if segment is None:
                 continue  # that member aborted earlier
@@ -1751,7 +1907,23 @@ class ControllerNode:
                 self.counters["duplicate_replies"] += 1
                 counted_duplicate = True
             segment["results"][key] = buf
-            segment["timings"][key] = msg.get("phase_timings")
+            share = member_shares.get(member_id)
+            try:
+                share = float(share) if share is not None else None
+            except (TypeError, ValueError):
+                share = None
+            timings = msg.get("phase_timings")
+            if share is not None and isinstance(timings, dict):
+                scaled = {
+                    k: round(v * share, 6)
+                    for k, v in timings.items()
+                    if isinstance(v, (int, float))
+                }
+                # underscore-namespaced like _total, so it can never
+                # collide with a real phase name
+                scaled["_member_share"] = round(share, 6)
+                timings = scaled
+            segment["timings"][key] = timings
             effective = msg.get("effective_strategy")
             if isinstance(effective, str):
                 segment.setdefault("effective", {})[key] = effective
@@ -1760,8 +1932,32 @@ class ControllerNode:
                 segment.setdefault("merge", {})[key] = merge_mode
             spans = msg.get("spans")
             if isinstance(spans, list) and segment.get("obs"):
-                segment["obs"]["spans"].extend(
-                    s for s in spans if isinstance(s, dict)
+                obs_state = segment["obs"]
+                if share is not None:
+                    # per-member span copies tagged with the share: the
+                    # autopsy keeps true-wall segments and reports this
+                    # member's accountable slice beside them
+                    obs_state["spans"].extend(
+                        dict(
+                            s,
+                            tags={
+                                **(s.get("tags") or {}),
+                                "bundle_share": round(share, 6),
+                            },
+                        )
+                        for s in spans if isinstance(s, dict)
+                    )
+                else:
+                    obs_state["spans"].extend(
+                        s for s in spans if isinstance(s, dict)
+                    )
+                obs_state["spans"].append(
+                    obs.make_span(
+                        obs_state["trace_id"], "demux", member_start_ts,
+                        time.perf_counter() - member_clock,
+                        parent_span_id=obs_state["qspan_id"],
+                        node=self.address,
+                    )
                 )
             self._maybe_complete_segment(parent)
         if not delivered:
@@ -1865,6 +2061,26 @@ class ControllerNode:
         if obs.enabled():
             self.admission_wait_seconds.observe(wait_s)
 
+    def _timeline_snapshot(self):
+        """One ``rpc.timeline()`` ring entry: the compact controller state
+        a regression diff needs — counters, queue/inflight depths, fleet
+        size, groupby latency quantiles, SLO burn rates."""
+        from bqueryd_tpu.obs import metrics as obs_metrics
+
+        snap = self.query_seconds.snapshot()
+        admission = self.admission.stats()
+        return {
+            "counters": dict(self.counters),
+            "inflight": len(self.inflight),
+            "workers": len(self.worker_map),
+            "admission_active": admission["active"],
+            "admission_queued": admission["queued"],
+            "groupby_count": sum(snap.get("counts", ())),
+            "groupby_p50_s": obs_metrics.quantile_from_snapshot(snap, 0.5),
+            "groupby_p99_s": obs_metrics.quantile_from_snapshot(snap, 0.99),
+            "slo": self.slo.snapshot(),
+        }
+
     @staticmethod
     def _compact_timings(timings):
         """Tuple-keyed per-shard timings -> JSON-safe compact keys (same
@@ -1906,6 +2122,21 @@ class ControllerNode:
                 shards=len(segment.get("filenames", ())),
             )
         self.query_seconds.observe(wall)
+        # SLO accounting: every finished groupby lands in its client class's
+        # deadline-margin histogram and burn-rate window.  An absolute
+        # client deadline wins over the class target; without one the
+        # margin is measured against the class's target_s
+        msg = segment["msg"]
+        deadline = msg.get("deadline")
+        margin_s = (
+            float(deadline) - time.time() if deadline is not None else None
+        )
+        self.slo.record(
+            msg.get("slo_class"),
+            wall,
+            margin_s=margin_s,
+            ok=error is None,
+        )
         if not obs_state:
             return
         trace_id = obs_state["trace_id"]
@@ -1941,6 +2172,14 @@ class ControllerNode:
         }
         if error is not None:
             timeline["error"] = str(error)[:500]
+        # critical-path attribution, assembled at trace completion: the
+        # autopsy record rides the stored timeline (rpc.autopsy /
+        # debug_bundle read it back for free); a malformed span set must
+        # never break query completion
+        try:
+            timeline["attribution"] = obs.slo.attribute(timeline)
+        except Exception:
+            self.logger.exception("attribution failed for %s", trace_id)
         self.trace_store.put(trace_id, timeline)
         recorded = self.slow_queries.maybe_record(
             wall,
@@ -1951,11 +2190,16 @@ class ControllerNode:
                 "filenames": len(segment["filenames"]),
                 "pruned_shards": len(segment.get("pruned", ())),
                 "plan_signature": segment.get("plan_sig"),
+                "slo_class": self.slo.resolve(msg.get("slo_class")),
                 "strategy_hints": dict(segment.get("strategies", {})),
                 "effective_strategies": self._compact_timings(
                     segment.get("effective")
                 ),
                 "phase_timings": self._compact_timings(segment.get("timings")),
+                # compact critical-path view (full record: rpc.autopsy)
+                "attribution": obs.slo.summarize(
+                    timeline.get("attribution")
+                ),
             },
         )
         if recorded:
@@ -2098,6 +2342,49 @@ class ControllerNode:
         reply.add_as_binary("result", self.slow_queries.entries())
         self.reply_rpc_message(msg.get("token"), reply)
 
+    def rpc_autopsy(self, msg):
+        """``rpc.autopsy(trace_id=None)``: the attributed critical-path
+        breakdown for one query (or the newest) — wall decomposed into
+        non-overlapping named segments with coverage accounting, the
+        per-attempt dispatch history (retries, backoff, hedges), and the
+        slow-query ring entry when the query crossed the threshold.  None
+        when the timeline fell out of the ring."""
+        args, kwargs = msg.get_args_kwargs()
+        trace_id = args[0] if args else kwargs.get("trace_id")
+        reply = msg.copy()
+        reply.add_as_binary("result", self.build_autopsy(trace_id))
+        self.reply_rpc_message(msg.get("token"), reply)
+
+    def build_autopsy(self, trace_id=None):
+        from bqueryd_tpu import obs
+
+        timeline = (
+            self.trace_store.get(trace_id)
+            if trace_id
+            else self.trace_store.latest()
+        )
+        if timeline is None:
+            return None
+        record = timeline.get("attribution")
+        if not isinstance(record, dict):
+            # a timeline stored before attribution existed (or whose
+            # assembly failed): attribute on demand
+            record = obs.slo.attribute(timeline)
+        record = dict(record)
+        slow = self.slow_queries.entry_for(record.get("trace_id"))
+        if slow is not None:
+            record["slow_query"] = slow
+        return record
+
+    def rpc_timeline(self, msg):
+        """``rpc.timeline()``: the bounded ring of periodic controller
+        registry snapshots (counters, queue depths, latency quantiles, SLO
+        burn rates; one entry per BQUERYD_TPU_TIMELINE_INTERVAL_S), oldest
+        first — regression spotting from one verb."""
+        reply = msg.copy()
+        reply.add_as_binary("result", self.timeline_ring.entries())
+        self.reply_rpc_message(msg.get("token"), reply)
+
     def rpc_health(self, msg):
         """Per-worker health statuses (ok/degraded/wedged) from the rolling
         latency/error baselines — the view dispatch routing acts on."""
@@ -2115,7 +2402,7 @@ class ControllerNode:
 
     def rpc_debug_bundle(self, msg):
         """``rpc.debug_bundle(trace_id=None)``: the cross-node forensic
-        artifact (schema ``bqueryd_tpu.debug_bundle/1``) — flight rings,
+        artifact (schema ``bqueryd_tpu.debug_bundle/2``) — flight rings,
         the requested (or newest) trace timeline, metrics and slow-query
         snapshots, per-worker compile registries and device health.  One
         JSON-safe dict you can attach to a bug report; dead peers degrade
@@ -2157,11 +2444,32 @@ class ControllerNode:
             },
             "health": self.health.statuses(),
             "trace": timeline,
+            # the attributed critical path of the bundled trace: the "where
+            # did the wall go" answer inline, not one more verb away
+            "autopsy": (timeline or {}).get("attribution"),
             "slow_queries": self.slow_queries.entries(),
             "metrics": self.metrics.histogram_snapshot(),
             "worker_histograms": self._aggregate_worker_histograms(),
             "runtime": obs_profile.runtime_versions(),
             "compile_cache": obs_profile.compile_cache_info(),
+            # subsystems grown since PR 3 — the forensic artifact must
+            # cover the failure surfaces that now shape a query's fate:
+            # measured-cost calibration (PR 6), chaos/fault-injection and
+            # replica placement (PR 8), the micro-batch window (PR 9), and
+            # the SLO/timeline accounting this PR adds
+            "calibration": {
+                **self.calibration.stats(),
+                "sample_cells": self.calibration.summary(max_cells=16),
+            },
+            "chaos": {
+                "armed": chaos.enabled(),
+                "injected_total": chaos.injected_total(),
+                "site_stats": chaos.site_stats(),
+            },
+            "replication": self._replication_info(),
+            "batch_window": self._batch_window_info(),
+            "slo": self.slo.snapshot(),
+            "timeline_ring": self.timeline_ring.entries()[-16:],
         }
         snapshots = {}
         for worker_id in set(self.worker_map) | set(self._worker_debug):
@@ -2229,17 +2537,7 @@ class ControllerNode:
             # replica placement visibility: the configured factor, shards
             # bucketed by live holder count, and the shards failover can't
             # yet help (fewer holders than the factor asks for)
-            "replication": {
-                "replica_factor": self.replica_factor,
-                "shards_by_holders": self._holder_counts(),
-                # the shards failover can't yet help: fewer live holders
-                # than the factor asks for (factor 0 = "all nodes" mode,
-                # where a single-holder shard is still the pager signal)
-                "under_replicated": sorted(
-                    f for f, holders in self.files_map.items()
-                    if len(holders) < (self.replica_factor or 2)
-                )[:64],
-            },
+            "replication": self._replication_info(),
             # every worker's latency histograms, merged by bucket-vector
             # addition (identical fixed buckets are the precondition, see
             # obs.metrics) — rides peer gossip too, so any controller can
@@ -2259,6 +2557,37 @@ class ControllerNode:
         if include_peers:
             info["others"] = self.others
         return info
+
+    def _replication_info(self):
+        """Replica placement visibility, shared by get_info and the debug
+        bundle: the configured factor, shards bucketed by live holder
+        count, and the shards failover can't yet help (factor 0 = "all
+        nodes" mode, where a single-holder shard is still the pager
+        signal)."""
+        return {
+            "replica_factor": self.replica_factor,
+            "shards_by_holders": self._holder_counts(),
+            "under_replicated": sorted(
+                f for f, holders in self.files_map.items()
+                if len(holders) < (self.replica_factor or 2)
+            )[:64],
+        }
+
+    def _batch_window_info(self):
+        """Micro-batch window state for the debug bundle: the live knobs
+        plus what is staged right now (a wedged flush shows up here)."""
+        from bqueryd_tpu.plan import bundle as bundlemod
+
+        window_state = {
+            "window_ms": bundlemod.batch_window_ms(),
+            "batch_max": bundlemod.batch_max(),
+            "staged": len(self._pending_window),
+        }
+        if self._pending_window:
+            window_state["opened_age_s"] = round(
+                max(time.time() - self._window_opened, 0.0), 3
+            )
+        return window_state
 
     def _aggregate_worker_histograms(self):
         # memoized on the snapshot revision: get_info runs once per peer per
@@ -2599,12 +2928,23 @@ class ControllerNode:
         compatible queries can fuse into one shared-scan dispatch."""
         from bqueryd_tpu.plan import bundle as bundlemod
 
+        from bqueryd_tpu import obs
+
         window_ms = bundlemod.batch_window_ms()
         if window_ms <= 0:
             self._launch_plan(msg, plan, kwargs)
             return
         if not self._pending_window:
             self._window_opened = time.time()
+            # flight ring: staging decisions are what a "why was this query
+            # 40 ms slower" timeline needs (hot path — kill-switch gated)
+            if obs.enabled():
+                self.flight.record("window_open", window_ms=window_ms)
+        # the batch_window span (staged -> flush) is carved out of the
+        # admission wait in _open_query_segment
+        obs_state = msg.get("_obs")
+        if isinstance(obs_state, dict):
+            obs_state["staged_ts"] = time.time()
         self._pending_window.append((msg, plan, kwargs))
         if len(self._pending_window) >= bundlemod.batch_max():
             self._flush_window(force=True)
@@ -2627,6 +2967,8 @@ class ControllerNode:
             return
         from bqueryd_tpu.plan import bundle as bundlemod
 
+        from bqueryd_tpu import obs
+
         pending, self._pending_window = self._pending_window, []
         groups = {}
         for staged in pending:
@@ -2638,12 +2980,28 @@ class ControllerNode:
                 # one malformed plan must not poison the whole window:
                 # group it solo; its own launch path replies the error
                 self.logger.exception("window compatibility probe failed")
+                # forensic event (never gated): a degrade-to-solo is the
+                # anomaly a "why didn't these fuse" timeline must show
+                self.flight.record(
+                    "window_degrade_solo",
+                    token=str(msg.get("token"))[:12],
+                )
                 keep, pruned, key = list(plan.filenames), [], None
             if key is None:
                 # unfusable (raw rows, basket expansion, non-mergeable
                 # aggs, batch=False, fully pruned): solo launch
                 key = ("solo", id(msg))
             groups.setdefault(key, []).append((msg, plan, kwargs, keep, pruned))
+        if obs.enabled():
+            self.flight.record(
+                "window_flush",
+                staged=len(pending),
+                groups=len(groups),
+                fused=sum(1 for g in groups.values() if len(g) > 1),
+                held_ms=round(
+                    max(time.time() - self._window_opened, 0.0) * 1000.0, 1
+                ),
+            )
         for entries in groups.values():
             try:
                 if len(entries) == 1:
@@ -2700,17 +3058,33 @@ class ControllerNode:
         obs_state = msg.get("_obs")
         if not isinstance(obs_state, dict):
             obs_state = self._new_obs_state(obs.TraceContext.new_root())
-        # the admission span covers submit -> launch: ~0 for an immediate
-        # ADMIT, the queue wait (and any window time) for staged plans
+        # the admission span covers submit -> launch (~0 for an immediate
+        # ADMIT, the queue wait for staged plans); time spent staged in the
+        # micro-batch window is carved into its own batch_window span so an
+        # autopsy can tell fusion-induced wait from admission backpressure
         if obs.enabled():
+            now = time.time()
+            staged_ts = obs_state.get("staged_ts")
+            admitted_until = (
+                min(staged_ts, now) if staged_ts is not None else now
+            )
             obs_state["spans"].append(
                 obs.make_span(
                     obs_state["trace_id"], "admission",
                     obs_state["submitted_ts"],
-                    max(time.time() - obs_state["submitted_ts"], 0.0),
+                    max(admitted_until - obs_state["submitted_ts"], 0.0),
                     parent_span_id=obs_state["qspan_id"], node=self.address,
                 )
             )
+            if staged_ts is not None:
+                obs_state["spans"].append(
+                    obs.make_span(
+                        obs_state["trace_id"], "batch_window", staged_ts,
+                        max(now - staged_ts, 0.0),
+                        parent_span_id=obs_state["qspan_id"],
+                        node=self.address,
+                    )
+                )
         segment = {
             "client_token": msg["token"],
             "msg": msg,

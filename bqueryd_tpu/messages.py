@@ -79,6 +79,10 @@ ENVELOPE_SCHEMA = {
     "token": "request identity: client socket token / shard work token",
     "priority": "admission queue priority (ascending)",
     "client_id": "admission quota bucket for RPC(client_id=...)",
+    "slo_class": "client-declared SLO class (RPC(slo_class=...)): selects "
+                 "the deadline-margin histogram / burn-rate bucket the "
+                 "query's outcome lands in (obs.slo; unknown classes fold "
+                 "into 'default')",
     "function": "remote-execution verb: pickled callable name",
     "needs_local": "route only to workers holding the file locally",
     # controller -> worker shard dispatch
@@ -113,6 +117,14 @@ ENVELOPE_SCHEMA = {
                       "pickled {payloads: {member_id: bytes}, errors: "
                       "{member_id: text}} envelope the controller "
                       "demultiplexes per member)",
+    "member_shares": "on shared-scan bundle replies: {member_id: fraction} "
+                     "of the bundle's shared scan wall each member is "
+                     "accountable for (measured per-member walls on the "
+                     "fallback path, an equal split on the one-program "
+                     "mesh path, 0.0 for result-cache hits) — the "
+                     "controller scales the shared phase_timings by it so "
+                     "a slow BUNDLE never lands every member in the "
+                     "slow-query ring with the whole bundle's wall",
     "transient": "on worker ErrorMessage replies: the failure is retryable "
                  "(chaos.TransientError class, e.g. DeviceBusyError) — the "
                  "controller fails the shard over to a different holder "
@@ -152,6 +164,9 @@ ENVELOPE_SCHEMA = {
                         "envelope (attempts key)",
     "_not_before": "controller-internal: failover backoff gate — the "
                    "dispatcher holds the shard until this timestamp",
+    "_backoff_s": "controller-internal: the backoff delay charged before "
+                  "this attempt's dispatch — the attribution layer carves "
+                  "it out of the dispatch span as a retry_backoff segment",
     "_bundle_parents": "controller-internal: member_id -> parent_token map "
                        "of a bundle dispatch; rides the envelope so the "
                        "reply (msg.copy) carries its own demux table",
@@ -219,6 +234,55 @@ WIRE_ONE_SIDED_OK = {
     "v": "bundle data-envelope version stamp written by "
          "worker._handle_bundle; the controller's demux tolerates v1 only "
          "today, so nothing reads it yet",
+}
+
+#: The declared truth of every SPAN NAME that can appear on a query trace
+#: timeline, diffed by ``bqueryd_tpu.analysis.spans`` against the literal
+#: span sites (``timer.phase("...")`` / ``self._phase("...")`` /
+#: ``recorder.span("...")`` / ``obs.make_span(trace_id, "...", ...)`` /
+#: ``SpanRecorder(root_name="...")``) the package actually contains, and
+#: against the attribution map in ``obs.slo.SPAN_CATEGORIES`` — so a new
+#: dispatch path cannot ship spans that ``rpc.autopsy`` silently drops into
+#: ``unattributed``.  RAW entries are worker PhaseTimer phase names; they
+#: surface on the wire under their public name via
+#: ``obs.trace.PHASE_SPAN_NAMES`` (noted per entry).  Adding a span site
+#: means adding its name here (and a category in obs.slo) in the same
+#: commit.
+SPAN_SCHEMA = {
+    # controller-side spans
+    "groupby": "the query's controller root span: submit -> final reply",
+    "admission": "admission-queue wait: submit -> launch (or -> staging)",
+    "batch_window": "micro-batch staging wait: window stage -> flush "
+                    "(BQUERYD_TPU_BATCH_WINDOW_MS)",
+    "plan": "logical-plan compilation + rewrites inside rpc_groupby",
+    "dispatch": "one dispatch ATTEMPT: queue entry -> worker send; tags "
+                "carry worker/retries/backoff_s/hedge so the attribution "
+                "layer can split out retry_backoff and hedge duplicates",
+    "demux": "shared-scan bundle reply demultiplex at the controller",
+    # worker-side spans (public names)
+    "calc": "the worker's root span for one CalcMessage",
+    "storage_decode": "raw phase 'open': shard open + column decode",
+    "prune": "raw phase: chunk-level predicate pruning",
+    "filter": "raw phase 'mask': where-term mask evaluation",
+    "factorize": "raw phase: key factorization (engine path)",
+    "align": "raw phase: cross-shard key alignment / global key space",
+    "h2d_transfer": "raw phase 'layout': host->device uploads",
+    "kernel": "raw phase 'aggregate': the compiled mesh program (collective "
+              "merge fused in; includes async dispatch wait)",
+    "d2h_fetch": "raw phase 'fetch': device->host fetch of the merged "
+                 "result buffer",
+    "merge": "raw phases 'collect'/'hostmerge': materialization / host "
+             "value-keyed merge of partials",
+    "reply_serialization": "raw phase 'serialize': result payload encoding",
+    # raw PhaseTimer names (surface via obs.trace.PHASE_SPAN_NAMES)
+    "open": "raw name of storage_decode",
+    "mask": "raw name of filter",
+    "layout": "raw name of h2d_transfer",
+    "aggregate": "raw name of kernel",
+    "fetch": "raw name of d2h_fetch",
+    "collect": "raw name of merge (device-path materialization)",
+    "hostmerge": "raw name of merge (host value-keyed merge)",
+    "serialize": "raw name of reply_serialization",
 }
 
 

@@ -34,6 +34,14 @@ proves stage overlap), ``bqueryd_tpu_workingset_*{segment=...}`` +
 :mod:`bqueryd_tpu.ops.workingset`), and the HBM-pressure shed counter
 ``bqueryd_tpu_workingset_pressure_evictions``.
 
+PR 10 adds the accounting tier on top of the spans:
+
+* :mod:`.slo` — per-query critical-path attribution (``rpc.autopsy``:
+  every query's wall decomposed into non-overlapping named segments with a
+  >= 95% coverage contract), per-client-class SLO accounting
+  (``bqueryd_tpu_slo_*`` margin histograms + burn-rate gauges), and the
+  bounded controller snapshot ring behind ``rpc.timeline()``.
+
 The hot path (span recording + histogram observes + flight envelope events
 + compile-call accounting) can be disabled with ``BQUERYD_TPU_METRICS=0``
 (or :func:`set_enabled`) — bench.py measures the enabled-vs-disabled delta
@@ -89,6 +97,7 @@ from bqueryd_tpu.obs.health import (  # noqa: F401
     HealthScorer,
 )
 from bqueryd_tpu.obs import profile  # noqa: F401
+from bqueryd_tpu.obs import slo  # noqa: F401
 
 _enabled = True
 

@@ -31,7 +31,14 @@ import tempfile
 import threading
 import time
 
-BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/1"
+#: schema /2 (PR 10): additive controller-section keys — ``autopsy`` (the
+#: bundled trace's attributed critical path), ``calibration`` (measured-cost
+#: store summary, PR 6), ``chaos`` (fault-injection stats, PR 8),
+#: ``replication`` (replica placement, PR 8), ``batch_window`` (micro-batch
+#: staging state, PR 9), ``slo`` (per-class accounting), ``timeline_ring``
+#: (periodic registry snapshots).  /1 consumers keep working: nothing was
+#: removed or renamed.
+BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/2"
 
 DEFAULT_CAPACITY = 512
 DEFAULT_MAX_BYTES = 1 << 20  # 1 MiB of ring per node

@@ -138,6 +138,19 @@ class SlowQueryLog:
         """Newest last, JSON-safe."""
         return list(self._entries)
 
+    def entry_for(self, trace_id):
+        """The (newest) ring entry for one trace id, or None — lets
+        ``rpc.autopsy(trace_id)`` attach the offender's slow-query record
+        (plan signature, strategy hints, scaled phase breakdown) to the
+        attribution instead of making the operator join two verbs by
+        hand."""
+        if not trace_id:
+            return None
+        for record in reversed(self._entries):
+            if record.get("trace_id") == trace_id:
+                return dict(record)
+        return None
+
     @property
     def nbytes(self):
         return self._nbytes

@@ -380,6 +380,31 @@ def readme_coverage_problems(registries, readme_text):
     return sorted(problems)
 
 
+def quantile_from_snapshot(entry, q):
+    """Approximate quantile from one histogram snapshot entry
+    (``{"buckets", "counts", ...}``, counts non-cumulative): the upper
+    bound of the bucket where the cumulative count crosses ``q``.  The
+    +Inf overflow slot reports the last finite bound (a ceiling is still
+    actionable; None would hide the signal).  None on empty/malformed
+    entries — the timeline ring snapshots latency quantiles per tick and a
+    cold histogram must render as "no data", not 0."""
+    try:
+        buckets = list(entry["buckets"])
+        counts = list(entry["counts"])
+    except (KeyError, TypeError):
+        return None
+    total = sum(counts)
+    if total <= 0 or len(counts) != len(buckets) + 1:
+        return None
+    threshold = max(float(q), 0.0) * total
+    cumulative = 0
+    for bound, count in zip(buckets, counts):
+        cumulative += count
+        if cumulative >= threshold:
+            return float(bound)
+    return float(buckets[-1]) if buckets else None
+
+
 def merge_histogram_snapshots(snapshots):
     """Aggregate per-worker histogram snapshots by bucket-vector addition.
 

@@ -15,9 +15,17 @@ schema note in :mod:`bqueryd_tpu.messages`).  Every hop derives child spans:
                      ├─ "h2d_transfer" ("layout")
                      ├─ "kernel" ("aggregate" — the psum collective merge is
                      │            fused into this compiled program)
+                     ├─ "d2h_fetch" ("fetch" — device→host fetch of the
+                     │            merged result buffer)
                      ├─ "merge" ("collect"/"hostmerge" — materialization of
                      │           the collectively-merged partials)
                      └─ "reply_serialization" ("serialize")
+
+The full span-name taxonomy is DECLARED in ``messages.SPAN_SCHEMA`` and
+cross-checked by the span-coverage lint (``bqueryd_tpu.analysis.spans``)
+against every literal span site and against the attribution map in
+:mod:`bqueryd_tpu.obs.slo` — a new span name ships declared and
+attributable, or the lint fails.
 
 Workers return their spans in calc replies (``"spans"`` key); the controller
 assembles the per-query timeline and keeps it in a :class:`TraceStore` ring
@@ -50,6 +58,7 @@ PHASE_SPAN_NAMES = {
     "mask": "filter",
     "layout": "h2d_transfer",
     "aggregate": "kernel",
+    "fetch": "d2h_fetch",
     "collect": "merge",
     "hostmerge": "merge",
     "serialize": "reply_serialization",

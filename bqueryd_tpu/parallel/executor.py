@@ -48,6 +48,7 @@ clocks feed the ``bqueryd_tpu_pipeline_busy_seconds`` gauges and bench.py's
 overlap ratio.
 """
 
+import contextlib
 import functools
 import os
 import threading
@@ -793,6 +794,7 @@ class MeshQueryExecutor:
                         strategy=strategy,
                         measure_index=measure_index,
                         merge_mode=merge_mode,
+                        timer=self.timer,
                     )
                     kernel_wall = time.perf_counter() - kernel_clock
                     break
@@ -1160,6 +1162,7 @@ class MeshQueryExecutor:
                 mesh, self.axis_name, n_prog, codes_d, masks_d,
                 tuple(measures_d), member_specs, sentinels,
                 strategy=strategy, merge_mode=merge_mode,
+                timer=self.timer,
             )
             if n_prog != n_groups:
                 merged_members = jax.tree_util.tree_map(
@@ -1439,7 +1442,7 @@ def _mesh_bundle_program(mesh, axis, n_groups, in_dtypes, in_width, pack,
 
 def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
                           member_specs, null_sentinels, strategy=None,
-                          merge_mode="psum"):
+                          merge_mode="psum", timer=None):
     """Run the bundle program and return the per-member merged partials
     tuple ON HOST (numpy leaves) — one packed fetch for the whole bundle
     when packing is enabled, with a per-query fallback to per-leaf
@@ -1492,8 +1495,21 @@ def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
         try:
             program, spec = run(True)
             with _collective_guard():
-                flat = np.asarray(jax.device_get(program(*args)))
-        except Exception:
+                out = program(*args)
+                _block_ready(out)
+                with _fetch_phase(timer):
+                    flat = np.asarray(jax.device_get(out))
+        except Exception as exc:
+            if isinstance(
+                exc, jax.errors.JaxRuntimeError
+            ) and _transient_status(exc):
+                # transient infrastructure fault (same contract as
+                # _mesh_partials): NOT evidence against packing, and
+                # re-executing the whole N-member bundle per-leaf on the
+                # same flaky backend would double the device work —
+                # propagate so the worker's degrade/failover machinery
+                # decides
+                raise
             import logging
 
             logging.getLogger("bqueryd_tpu").exception(
@@ -1510,7 +1526,10 @@ def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
             return finish(merged, flat.nbytes)
     program, _spec = run(False)
     with _collective_guard():
-        result = jax.device_get(program(*args))
+        out = program(*args)
+        _block_ready(out)
+        with _fetch_phase(timer):
+            result = jax.device_get(out)
     fetched = sum(
         np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(result)
     )
@@ -1645,9 +1664,44 @@ def _record_merge_bytes(merge_mode, fetched, n_dev, n_groups, merged):
     )
 
 
+@contextlib.contextmanager
+def _fetch_phase(timer):
+    """The D2H fetch timed as its own phase ("fetch" -> span "d2h_fetch"):
+    the program output is blocked-until-ready first, so what this phase
+    measures is the transfer itself, not the async kernel dispatch it used
+    to hide inside the "aggregate" wall.  The fetch runs serially nested
+    inside the open "aggregate" phase, so its wall is DEBITED from
+    aggregate — one second of D2H bills the fetch phase once, not the
+    kernel histogram too."""
+    if timer is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        with timer.phase("fetch"):
+            yield
+    finally:
+        timer.debit("aggregate", time.perf_counter() - t0)
+
+
+def _block_ready(out):
+    """``jax.block_until_ready`` with a pytree-walking fallback for older
+    jaxlibs that predate the top-level helper."""
+    import jax
+
+    block = getattr(jax, "block_until_ready", None)
+    if block is not None:
+        return block(out)
+    return jax.tree_util.tree_map(
+        lambda a: a.block_until_ready()
+        if hasattr(a, "block_until_ready") else a,
+        out,
+    )
+
+
 def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
                    null_sentinels=None, strategy=None, measure_index=None,
-                   merge_mode="psum"):
+                   merge_mode="psum", timer=None):
     """Run the mesh program and return the merged partials pytree ON HOST
     (numpy leaves) — fetching one packed buffer when packing is enabled.
     ``measures_d`` holds one device block per DISTINCT measure column;
@@ -1655,7 +1709,10 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
 
     ``merge_mode`` shapes the result: ``device``/``psum`` return the merged
     table (leaves ``[n_groups]``); ``host`` returns the UNMERGED per-device
-    partials (leaves ``[n_dev, n_groups]``) for the hostmerge fallback."""
+    partials (leaves ``[n_dev, n_groups]``) for the hostmerge fallback.
+
+    ``timer``: optional PhaseTimer; the device→host fetch is carved into
+    its own "fetch" phase so attribution can split kernel wall from D2H."""
     global _packed_fetch_broken
     import jax
 
@@ -1709,7 +1766,9 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             program, spec = run(True)
             with _collective_guard():
                 out = program(codes_d, *measures_d)
-                flat = np.asarray(jax.device_get(out))
+                _block_ready(out)
+                with _fetch_phase(timer):
+                    flat = np.asarray(jax.device_get(out))
         except Exception as exc:
             if (
                 isinstance(exc, jax.errors.JaxRuntimeError)
@@ -1755,7 +1814,10 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
             return finish(merged, flat.nbytes)
     program, _spec = run(False)
     with _collective_guard():
-        result = jax.device_get(program(codes_d, *measures_d))
+        out = program(codes_d, *measures_d)
+        _block_ready(out)
+        with _fetch_phase(timer):
+            result = jax.device_get(out)
     if latch_pending:
         _packed_fetch_broken = True
         _packed_transient_count = 0

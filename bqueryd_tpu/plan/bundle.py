@@ -137,6 +137,33 @@ def bundle_to_queries(fragment):
     return out
 
 
+def member_shares(executed_ids, walls=None):
+    """Per-member accountability fractions for a bundle's shared scan:
+    ``{member_id: share}`` summing to 1.0 over the executed members.
+
+    On the per-member fallback path the worker measures each member's own
+    execution wall (``walls``) and shares are proportional; on the
+    one-program mesh path no per-member wall exists, so the shared scan
+    splits equally — the honest prior when one kernel served everyone.
+    Result-cache hits are NOT executed members (the caller reports them at
+    0.0: they consumed no scan).  The controller scales the bundle reply's
+    shared ``phase_timings`` by these, so a slow bundle never lands every
+    member in the slow-query ring with the whole bundle's wall."""
+    executed = list(executed_ids)
+    if not executed:
+        return {}
+    if walls:
+        total = sum(max(float(walls.get(m, 0.0)), 0.0) for m in executed)
+        if total > 0.0 and all(
+            float(walls.get(m, 0.0)) > 0.0 for m in executed
+        ):
+            return {
+                m: round(float(walls[m]) / total, 6) for m in executed
+            }
+    share = round(1.0 / len(executed), 6)
+    return {m: share for m in executed}
+
+
 def fragment_strategy(fragment):
     """The kernel-strategy hint a bundle fragment carries, with the binding
     promotion reconstructed under the same ``BQUERYD_TPU_CALIB`` kill-switch

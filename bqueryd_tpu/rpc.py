@@ -61,6 +61,7 @@ class RPC:
         retries=3,
         legacy_merge=False,
         client_id=None,
+        slo_class=None,
     ):
         bqueryd_tpu.configure_logging(loglevel)
         self.logger = bqueryd_tpu.logger.getChild("rpc")
@@ -72,6 +73,10 @@ class RPC:
         # controller's per-client quota (BQUERYD_TPU_ADMIT_CLIENT_QUOTA);
         # unset, each socket identity is its own bucket
         self.client_id = client_id
+        # SLO class declaration: rides every request envelope (`slo_class`
+        # key) so the controller buckets this client's deadline margins and
+        # burn rates under the right class (obs.slo; unknown -> "default")
+        self.slo_class = slo_class
         self.last_call_duration = None
         #: attempts the most recent call consumed (1 = first try answered;
         #: >1 means timeouts/reconnects/BUSY backoff were absorbed) — the
@@ -89,6 +94,11 @@ class RPC:
         #: ("device" = ICI-mesh collective merge, "host" = hostmerge
         #: fallback, "none" = single payload) — how the answer was merged
         self.last_call_merge_modes = None
+        #: client-side deserialize+merge wall of the most recent groupby —
+        #: the one segment the controller cannot see; ``autopsy()`` folds it
+        #: into the fetched attribution record
+        self.last_call_client_merge_s = None
+        self._client_merge_by_trace = {}   # trace_id -> seconds (bounded)
         self.identity = os.urandom(8).hex()
         self.store = coordination_store(
             coordination_url or redis_url or bqueryd_tpu.DEFAULT_COORDINATION_URL
@@ -166,6 +176,8 @@ class RPC:
             msg["priority"] = priority
         if self.client_id is not None:
             msg["client_id"] = self.client_id
+        if self.slo_class is not None:
+            msg["slo_class"] = self.slo_class
         # end-to-end tracing: every call mints a root TraceContext; the
         # controller parents its query spans to it and keeps the assembled
         # timeline retrievable via rpc.trace(rpc.last_trace_id)
@@ -294,14 +306,29 @@ class RPC:
             err.error_class = error_class
             err.attempts = attempts
             raise err
+        # client deserialize + merge: the one critical-path segment that
+        # happens after the controller sealed the trace — measured here,
+        # keyed by trace id, folded into autopsy() records on demand
+        merge_clock = time.perf_counter()
         payloads = [ResultPayload.from_bytes(b) for b in envelope["payloads"]]
         self.last_call_timings = envelope.get("timings")
         self.last_call_strategies = envelope.get("strategies")
         self.last_call_merge_modes = envelope.get("merge_modes")
         if self.legacy_merge:
-            return self._legacy_merge_frames(payloads)
-        merged = hostmerge.merge_payloads(payloads)
-        return hostmerge.payload_to_dataframe(merged)
+            result = self._legacy_merge_frames(payloads)
+        else:
+            merged = hostmerge.merge_payloads(payloads)
+            result = hostmerge.payload_to_dataframe(merged)
+        self.last_call_client_merge_s = time.perf_counter() - merge_clock
+        if self.last_trace_id:
+            self._client_merge_by_trace[self.last_trace_id] = (
+                self.last_call_client_merge_s
+            )
+            while len(self._client_merge_by_trace) > 32:
+                self._client_merge_by_trace.pop(
+                    next(iter(self._client_merge_by_trace))
+                )
+        return result
 
     def _legacy_merge_frames(self, payloads):
         """Reference-quirk mode: finalize each shard separately, then re-merge
@@ -326,6 +353,30 @@ class RPC:
         if key_cols is None:
             return stacked
         return stacked.groupby(key_cols, sort=True).sum().reset_index()
+
+    # -- query autopsy -----------------------------------------------------
+    def autopsy(self, trace_id=None):
+        """The attributed critical-path breakdown for one query (default:
+        the controller's newest trace): named non-overlapping segments,
+        coverage accounting, per-attempt dispatch history.  When this
+        client executed the query's merge itself (the usual groupby path),
+        the locally measured ``client_deserialize`` segment — invisible to
+        the controller, which seals the trace before the client unpickles —
+        is folded in and the coverage recomputed over the extended wall."""
+        record = self._rpc("autopsy", (trace_id,) if trace_id else (), {})
+        if not isinstance(record, dict):
+            return record
+        merge_s = self._client_merge_by_trace.get(record.get("trace_id"))
+        if merge_s:
+            segments = record.setdefault("segments", {})
+            segments["client_deserialize"] = round(merge_s, 6)
+            wall = float(record.get("wall_s") or 0.0) + merge_s
+            covered = float(record.get("covered_s") or 0.0) + merge_s
+            record["wall_s"] = round(wall, 6)
+            record["covered_s"] = round(covered, 6)
+            if wall > 0:
+                record["coverage"] = round(covered / wall, 4)
+        return record
 
     # -- download helpers (client-local, straight to the store) ------------
     def get_download_data(self):

@@ -63,6 +63,18 @@ class PhaseTimer:
                     self.span_names.get(name, name), start_ts, duration
                 )
 
+    def debit(self, name, seconds):
+        """Subtract a SERIALLY-NESTED sub-phase's wall from its enclosing
+        phase (e.g. the device→host ``fetch`` runs inside ``aggregate``):
+        without the debit the same seconds bill twice — once per phase —
+        in ``phase_timings`` and the per-phase histograms.  The enclosing
+        phase's entry may not exist yet (it lands on context exit), so
+        this accumulates a negative adjustment that the later sum nets
+        out exactly.  Only for nested SERIAL work — genuinely concurrent
+        phase overlap is a measured property, never debited."""
+        with self._lock:
+            self.timings[name] = self.timings.get(name, 0.0) - seconds
+
     def total(self):
         return time.perf_counter() - self._started
 

@@ -1427,15 +1427,7 @@ class WorkerNode(WorkerBase):
             reply["spans"] = recorder.export(tags=mem_tags)
             self.groupby_queries.inc()
             self.groupby_seconds.observe(timer.total())
-            for phase, seconds in timer.timings.items():
-                self.metrics.histogram(
-                    "bqueryd_tpu_query_phase_seconds",
-                    "per-phase worker latency (storage decode, H2D, "
-                    "kernel, merge, ...)",
-                    labels={
-                        "phase": obs.PHASE_SPAN_NAMES.get(phase, phase)
-                    },
-                ).observe(seconds)
+            self._observe_phase_histograms(timer)
         # deadline propagation: the reply keeps the envelope's ``deadline``
         # (msg.copy) and reports the budget left after execution
         remaining = msg.deadline_remaining()
@@ -1459,6 +1451,21 @@ class WorkerNode(WorkerBase):
             reply["merge_mode"] = merge_mode
         self.logger.debug("calc %s done: %s", filename, timer.as_dict())
         return reply
+
+    def _observe_phase_histograms(self, timer):
+        """One ``bqueryd_tpu_query_phase_seconds{phase=...}`` observation
+        per timed phase — the single registration site both groupby reply
+        paths (solo and bundle) share, so the family's help text and label
+        mapping can never diverge between them."""
+        from bqueryd_tpu import obs
+
+        for phase, seconds in timer.timings.items():
+            self.metrics.histogram(
+                "bqueryd_tpu_query_phase_seconds",
+                "per-phase worker latency (storage decode, H2D, "
+                "kernel, merge, ...)",
+                labels={"phase": obs.PHASE_SPAN_NAMES.get(phase, phase)},
+            ).observe(seconds)
 
     def _bundle_mesh_eligible(self, tables, queries):
         """Mirror of the single-query ``_execute`` routing decision for a
@@ -1553,6 +1560,11 @@ class WorkerNode(WorkerBase):
                     continue
             active.append((member_id, query))
 
+        # per-member segment shares (messages.py `member_shares`): measured
+        # walls on the fallback path, an equal split on the one-program
+        # mesh path; cached members report 0.0 (they consumed no scan)
+        cached_ids = list(payloads)
+        member_walls = {}
         results = {}
         if active:
             queries = [q for _mid, q in active]
@@ -1593,8 +1605,12 @@ class WorkerNode(WorkerBase):
             else:
                 for member_id, query in active:
                     try:
+                        exec_clock = time.perf_counter()
                         results[member_id] = self._execute(
                             tables, query, timer, strategy=strategy
+                        )
+                        member_walls[member_id] = (
+                            time.perf_counter() - exec_clock
                         )
                     except chaos.TransientError:
                         raise  # whole-bundle failover, as above
@@ -1634,6 +1650,10 @@ class WorkerNode(WorkerBase):
         reply = msg.copy()
         reply["data"] = data
         reply["bundle_members"] = [mid for mid, _dl, _q in members]
+        reply["member_shares"] = {
+            **{mid: 0.0 for mid in cached_ids},
+            **bundlemod.member_shares(list(results), walls=member_walls),
+        }
         reply["phase_timings"] = timer.as_dict()
         if recorder is not None:
             reply["spans"] = recorder.export()
@@ -1642,6 +1662,11 @@ class WorkerNode(WorkerBase):
             # controller's plan_bundled_queries
             self.groupby_queries.inc()
             self.groupby_seconds.observe(timer.total())
+            # same per-phase histograms as the solo reply path: with the
+            # window on, bundles ARE the dominant serving path — a phase
+            # regression there must not vanish from the very histograms
+            # built to catch it
+            self._observe_phase_histograms(timer)
         # route/merge visibility mirrors the single-query reply: the last
         # executed route speaks for the bundle (members share one shape);
         # "cached" only when cache hits actually served members — a bundle

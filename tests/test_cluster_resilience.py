@@ -1085,6 +1085,49 @@ def test_transient_device_fault_retries_on_other_holder(
         _stop([controller] + workers, threads)
 
 
+def test_autopsy_attributes_failover_backoff(tmp_path, mem_store_url):
+    """A query that survives a transient device fault (wedge -> failover to
+    the other holder) must autopsy with the recovery visible: a failed
+    attempt, a retry whose backoff window appears as a retry_backoff
+    segment, and segments that still sum consistently with the wall."""
+    from bqueryd_tpu import chaos
+
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        chaos.arm({
+            "seed": 2,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "wedge",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        rpc, got = _ask_sum(mem_store_url, shards)
+        assert got == expected
+        assert controller.counters["failover_dispatches"] >= 1
+        record = rpc.autopsy(rpc.last_trace_id)
+        assert record is not None and record["ok"] is True
+        # the wedged attempt + the failover retry are both listed; the
+        # retry excludes the faulted holder and charged a backoff window
+        assert len(record["attempts"]) >= 2
+        retries = [a for a in record["attempts"] if a["retries"] >= 1]
+        assert retries and retries[0]["backoff_s"] > 0
+        failed = [a for a in record["attempts"] if a.get("failed")]
+        assert failed and failed[0]["worker"]
+        assert record["segments"]["retry_backoff"] > 0
+        # the non-overlap invariant holds under faults too
+        total = sum(record["segments"].values()) + record["unattributed_s"]
+        assert abs(total - record["wall_s"]) < 1e-3
+        # recovery time is attributed, not mystery wall
+        assert record["coverage"] >= 0.8
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
 def test_duplicated_reply_is_deduped_by_query_token(tmp_path, mem_store_url):
     """A reply the chaos plan duplicates at the controller must be counted
     (duplicate_replies) and not double-merged: sums stay bit-identical."""
