@@ -654,6 +654,275 @@ def _conc_frames_match(a, b, key_cols):
     return identical, max_rel
 
 
+def _pct(values, q):
+    """Sorted-index percentile of a wall list (None on empty)."""
+    ordered = sorted(values)
+    if not ordered:
+        return None
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+def _open_loop_swarm(url, make_query, offered_qps, duration_s,
+                     n_clients=8):
+    """Open-loop load against a live controller: ``n_clients`` REQ threads
+    share one global send schedule at ``offered_qps`` (slot k fires at
+    t0 + k/offered).  A client whose slot is overdue while it was still
+    waiting on a reply sends immediately — lockstep REQ sockets are the
+    natural backpressure above saturation, and achieved < offered is
+    exactly the knee signal the ramp measures.  Returns
+    ``(achieved_qps, walls, n_completed)``."""
+    import itertools
+
+    from bqueryd_tpu.rpc import RPC
+
+    lock = threading.Lock()
+    walls = []
+    errors = []
+    slots = itertools.count()
+    t0 = [None]
+    barrier = threading.Barrier(n_clients)
+
+    def client(ci):
+        try:
+            rpc = RPC(
+                coordination_url=url, timeout=RPC_TIMEOUT,
+                loglevel=logging.WARNING,
+            )
+            barrier.wait(timeout=300)
+            with lock:
+                if t0[0] is None:
+                    t0[0] = time.perf_counter()
+            while True:
+                k = next(slots)
+                due_offset = k / offered_qps
+                if due_offset >= duration_s:
+                    return
+                due = t0[0] + due_offset
+                now = time.perf_counter()
+                if due > now:
+                    time.sleep(due - now)
+                q0 = time.perf_counter()
+                rpc.groupby(*make_query(k))
+                with lock:
+                    walls.append(time.perf_counter() - q0)
+        except Exception as exc:
+            errors.append(exc)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=client, args=(ci,), daemon=True)
+        for ci in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    t_end = time.perf_counter()
+    if errors:
+        raise errors[0]
+    # achieved over the post-barrier clock (t0): per-client RPC
+    # construction and barrier sync must not dilute the rate — only the
+    # in-flight drain tail (bounded by one query wall) remains inside
+    elapsed = t_end - t0[0] if t0[0] is not None else 1e-9
+    return len(walls) / max(elapsed, 1e-9), walls, len(walls)
+
+
+def run_capacity_section(names, controller_node, coord_url,
+                         slo_combined_pct=None):
+    """The capacity gate: an open-loop load ramp against the live bench
+    cluster.  Asserts (BENCH_CAPACITY_GATE=0 records without asserting):
+    the model measured every worker (coverage), the predicted saturation
+    knee brackets the measured QPS plateau within ±25%, the shadow advisor
+    recommends scale_up at measured saturation and nothing at low load,
+    model-vs-measured queue-delay drift is reported, and the capacity
+    evaluation microcost keeps the combined observability overhead under
+    the 2% budget (the obs/slo overhead legs already ran with the model's
+    taps live)."""
+    gate_on = os.environ.get("BENCH_CAPACITY_GATE", "1") == "1"
+    detail = {"legs": []}
+    knob_env = {
+        # a short window so each ramp leg's rate dominates the estimate,
+        # and a short (but non-zero: the mechanism stays exercised)
+        # hysteresis so a 10 s leg can flip the state machine
+        "BQUERYD_TPU_CAPACITY_WINDOW_S": "12",
+        "BQUERYD_TPU_CAPACITY_HYSTERESIS_S": "1",
+    }
+    prior = {k: os.environ.get(k) for k in knob_env}
+    os.environ.update(knob_env)
+    try:
+        duration_s = float(os.environ.get("BENCH_CAPACITY_LEG_S", "10"))
+        n_clients = int(os.environ.get("BENCH_CAPACITY_CLIENTS", "6"))
+
+        def make_query(k):
+            # distinct filter threshold per slot: the PR-1 identical-work
+            # dedup must not fuse concurrent ramp queries (that would
+            # measure sharing, not capacity)
+            return (
+                names,
+                ["passenger_count"],
+                [["fare_amount", "sum", "fare_sum"]],
+                [["trip_distance", ">", round(0.02 + 0.0013 * k, 4)]],
+            )
+
+        # closed-loop saturation probe: n_clients hammering back to back
+        # approximates the throughput plateau (the measured knee), and
+        # warms the model's μ windows
+        probe_queries = [
+            [make_query(10_000 + ci * 50 + k) for k in range(3)]
+            for ci in range(n_clients)
+        ]
+        _, probe_walls, probe_elapsed = _conc_swarm(
+            coord_url, probe_queries, None
+        )
+        closed_qps = len(probe_walls) / max(probe_elapsed, 1e-9)
+        detail["closed_loop_qps"] = round(closed_qps, 4)
+        # let the probe's saturation drain out of the rate windows and the
+        # busy EWMA before the ramp: the low leg must measure LOW load,
+        # not the probe's afterglow
+        time.sleep(6)
+
+        measured_knee = closed_qps
+        low_recs = sat_recs = None
+        for label, factor in (
+            ("low", 0.3), ("mid", 0.7), ("overload", 1.4)
+        ):
+            # the floor only guards a degenerate probe; it must stay WELL
+            # below any realistic knee or the 10M low leg (knee ~1 qps)
+            # would sit at the warm/saturated boundary instead of at 0.3x
+            offered = max(closed_qps * factor, 0.15)
+            achieved, leg_walls, n_done = _open_loop_swarm(
+                coord_url, make_query, offered, duration_s,
+                n_clients=n_clients,
+            )
+            result = controller_node.capacity.evaluate()
+            fleet = result.get("fleet", {})
+            actions = [
+                r["action"] for r in result.get("recommendations", ())
+            ]
+            detail["legs"].append({
+                "leg": label,
+                "offered_qps": round(offered, 4),
+                "achieved_qps": round(achieved, 4),
+                "completed": n_done,
+                "p50_s": round(_pct(leg_walls, 0.50) or 0.0, 4),
+                "p99_s": round(_pct(leg_walls, 0.99) or 0.0, 4),
+                "fleet_state": fleet.get("state"),
+                "fleet_utilization": fleet.get("utilization"),
+                "model_knee_qps": fleet.get("knee_qps"),
+                "recommendations": actions,
+            })
+            measured_knee = max(measured_knee, achieved)
+            if label == "low":
+                low_recs = actions
+            if label == "overload":
+                sat_recs = actions
+        final = controller_node.capacity.evaluate()
+        fleet = final.get("fleet", {})
+        predicted_knee = fleet.get("knee_qps")
+        detail["measured_knee_qps"] = round(measured_knee, 4)
+        detail["predicted_knee_qps"] = predicted_knee
+        knee_ratio = (
+            predicted_knee / measured_knee
+            if predicted_knee and measured_knee > 0 else None
+        )
+        detail["knee_ratio"] = (
+            round(knee_ratio, 4) if knee_ratio is not None else None
+        )
+        detail["knee_within_25pct"] = (
+            knee_ratio is not None and 0.75 <= knee_ratio <= 1.25
+        )
+        detail["model_coverage"] = fleet.get("coverage")
+        detail["model_drift"] = fleet.get("model_drift")
+        detail["predicted_queue_delay_s"] = fleet.get(
+            "predicted_queue_delay_s"
+        )
+        detail["measured_queue_delay_s"] = fleet.get(
+            "measured_queue_delay_s"
+        )
+        detail["worker_resets"] = controller_node.capacity.worker_resets()
+        detail["low_load_recommendations"] = low_recs
+        detail["saturated_recommendations"] = sat_recs
+        detail["advisor_flipped_to_scale_up"] = bool(
+            sat_recs and "scale_up" in sat_recs
+        )
+        detail["scale_up_advised_total"] = controller_node.counters[
+            "capacity_scale_up_advised"
+        ]
+        detail["shard_heat_top"] = final.get("shard_heat", [])[:4]
+
+        # evaluation microcost: the taps were live through every measured
+        # section (the obs/slo overhead legs cover them); what's left is
+        # the periodic evaluate, amortized at the bench heartbeat cadence
+        # against the headline wall
+        K = 200
+        t0 = time.perf_counter()
+        for _ in range(K):
+            controller_node.capacity.evaluate()
+        eval_s = (time.perf_counter() - t0) / K
+        hb = max(controller_node.heartbeat_interval, 1e-3)
+        eval_pct = eval_s / hb * 100.0
+        detail["evaluate_cost_ms"] = round(eval_s * 1e3, 4)
+        detail["evaluate_overhead_pct"] = round(eval_pct, 4)
+        # the whole-path budget: the slo section's combined spans +
+        # attribution overhead (measured with the capacity TAPS live —
+        # the model is on throughout the bench) plus the periodic
+        # evaluate, against the same 2% ceiling
+        combined = None
+        if slo_combined_pct is not None:
+            combined = round(slo_combined_pct + eval_pct, 4)
+        detail["combined_overhead_pct_with_capacity"] = combined
+
+        print(
+            f"[bench] capacity: measured knee "
+            f"{detail['measured_knee_qps']:.2f} qps vs predicted "
+            f"{predicted_knee if predicted_knee else float('nan'):.2f} "
+            f"(ratio {detail['knee_ratio']}), low-load advice "
+            f"{low_recs}, saturated advice {sat_recs}, drift "
+            f"{detail['model_drift']}, evaluate "
+            f"{detail['evaluate_cost_ms']:.3f} ms",
+            file=sys.stderr, flush=True,
+        )
+        if gate_on:
+            assert detail["model_coverage"] == 1.0, (
+                f"capacity model coverage {detail['model_coverage']} — "
+                "some live worker was never measured"
+            )
+            assert detail["knee_within_25pct"], (
+                f"predicted knee {predicted_knee} vs measured "
+                f"{measured_knee:.2f} qps (ratio {detail['knee_ratio']}) "
+                "outside the ±25% bracket"
+            )
+            assert "scale_up" not in (low_recs or []), (
+                f"advisor recommended scale_up at 0.3x load: {low_recs}"
+            )
+            assert detail["advisor_flipped_to_scale_up"], (
+                f"advisor never flipped to scale_up at saturation: "
+                f"{sat_recs}"
+            )
+            assert detail["model_drift"] is not None, (
+                "model-vs-measured queue-delay drift never computed"
+            )
+            assert eval_pct < 2.0, (
+                f"capacity evaluate costs {eval_pct:.2f}% of a heartbeat "
+                "interval (budget: 2%)"
+            )
+            if combined is not None:
+                assert combined <= 2.0, (
+                    f"obs + attribution + capacity overhead {combined}% "
+                    "of the hot-path wall (budget: 2%)"
+                )
+        return detail
+    finally:
+        for key, value in prior.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
 def run_chaos_section(names):
     """The chaos gate: each scripted scenario (kill-worker, drop-reply,
     wedge-device, redis-partition) runs the burst over its own fresh
@@ -2318,11 +2587,7 @@ def main():
                     if not identical:
                         parity_bad.append(qkey)
 
-                def pct(walls, q):
-                    walls = sorted(walls)
-                    return walls[
-                        min(int(len(walls) * q), len(walls) - 1)
-                    ]
+                pct = _pct  # module-level helper, shared with the capacity ramp
 
                 qps_fused = n_queries / fused_elapsed
                 qps_unfused = n_queries / unfused_elapsed
@@ -2420,6 +2685,39 @@ def main():
                     raise
                 print(
                     f"[bench] chaos section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
+        # capacity: the fleet capacity model's load ramp — an open-loop
+        # offered-QPS sweep on the live cluster gating the predicted
+        # saturation knee against the measured throughput plateau (±25%),
+        # the shadow advisor's flip to scale_up at saturation (and
+        # silence at low load), model coverage/drift, and the combined
+        # observability overhead budget with the model enabled
+        capacity_detail = {}
+        if (
+            os.environ.get("BENCH_CAPACITY", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            try:
+                capacity_detail = run_capacity_section(
+                    names, nodes[0], nodes[0].store.url,
+                    slo_combined_pct=slo_detail.get(
+                        "combined_overhead_pct"
+                    ),
+                )
+            except AssertionError:
+                raise  # the capacity gate's assertions are deliberate
+            except Exception as exc:
+                if os.environ.get("BENCH_CAPACITY_GATE", "1") == "1":
+                    # same contract as the chaos/slo gates: a setup crash
+                    # must fail the armed gate, not record capacity={}
+                    # and read as green
+                    raise
+                print(
+                    f"[bench] capacity section failed: {exc!r}",
                     file=sys.stderr,
                     flush=True,
                 )
@@ -2527,6 +2825,10 @@ def main():
             # fault-injection scenarios: zero-failed-query gate, result
             # parity vs the fault-free run, failover/hedge counters
             "chaos": chaos_detail,
+            # fleet capacity model: load-ramp knee bracket (±25%), shadow
+            # advisor flip at saturation, model coverage/drift, and the
+            # evaluate microcost inside the observability budget
+            "capacity": capacity_detail,
             # suite runtime + per-family finding counts (the bench guard
             # proving the full static pass stays under a few seconds)
             "static_analysis": static_analysis_detail,
@@ -2623,6 +2925,12 @@ def main():
                         ),
                         "chaos_failovers": chaos_detail.get(
                             "failover_dispatches_total"
+                        ),
+                        "capacity_knee_ratio": capacity_detail.get(
+                            "knee_ratio"
+                        ),
+                        "capacity_advisor_flipped": capacity_detail.get(
+                            "advisor_flipped_to_scale_up"
                         ),
                         "jit_cache_hit_rate": profiling_detail.get(
                             "jit_cache_hit_rate"

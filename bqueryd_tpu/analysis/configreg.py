@@ -243,6 +243,34 @@ ENV_REGISTRY = {
            "ENTRY-COUNT cap on the rpc.timeline() snapshot ring (newest "
            "kept; distinct from the _INTERVAL_S period)",
            read_time="import", related=("TIMELINE_INTERVAL_S",)),
+        _v("CAPACITY", "flag", "1",
+           "fleet capacity model: μ/λ/ρ accounting, saturation states and "
+           "the shadow scaling advisor behind rpc.capacity() (0 = taps and "
+           "evaluation off)",
+           related=("CAPACITY_WINDOW_S", "CAPACITY_RHO_WARM",
+                    "CAPACITY_RHO_SATURATED", "CAPACITY_HYSTERESIS_S",
+                    "CAPACITY_TARGET_RHO")),
+        _v("CAPACITY_WINDOW_S", "float", "60",
+           "rolling window the capacity model's arrival/dispatch rates are "
+           "measured over",
+           related=("CAPACITY",)),
+        _v("CAPACITY_RHO_WARM", "float", "0.5",
+           "utilization at which a worker/fleet classifies warm "
+           "(saturated and overloaded sit above; see _RHO_SATURATED)",
+           related=("CAPACITY", "CAPACITY_RHO_SATURATED")),
+        _v("CAPACITY_RHO_SATURATED", "float", "0.8",
+           "utilization at which a worker/fleet classifies saturated "
+           "(>= 1.0 is overloaded by definition, not a knob)",
+           related=("CAPACITY", "CAPACITY_RHO_WARM")),
+        _v("CAPACITY_HYSTERESIS_S", "float", "10",
+           "a capacity state change must persist this many seconds before "
+           "it takes (0 = flip immediately)",
+           related=("CAPACITY",)),
+        _v("CAPACITY_TARGET_RHO", "float", "0.7",
+           "utilization the shadow advisor sizes the fleet for: scale_up "
+           "asks for enough workers to return ρ here, scale_down sheds "
+           "only what the target leaves headroom for",
+           related=("CAPACITY",)),
         _v("LOG_JSON", "flag", "0",
            "structured JSON log lines with trace correlation ids"),
         _v("COMPILE_PROFILE", "flag", "1",

@@ -74,7 +74,7 @@ CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
     "download", "readfile", "execute_code", "sleep", "groupby",
     "trace", "metrics", "slow_queries", "health", "debug_bundle",
-    "autopsy", "timeline",
+    "autopsy", "timeline", "capacity",
 )
 
 #: help text for every controller counter — the spec the registry-backed
@@ -124,6 +124,14 @@ COUNTER_SPECS = {
         "worker replies deduplicated by query token (hedge losers, "
         "late retries, chaos-duplicated envelopes) — counted, never "
         "double-merged",
+    "capacity_scale_up_advised":
+        "shadow-advisor scale_up recommendations emitted (advisory only — "
+        "logged to the flight ring, never acted on)",
+    "capacity_scale_down_advised":
+        "shadow-advisor scale_down recommendations emitted (advisory only)",
+    "capacity_rebalance_advised":
+        "shadow-advisor shard-rebalance recommendations emitted (advisory "
+        "only)",
 }
 
 
@@ -315,6 +323,44 @@ class ControllerNode:
         # periodically behind rpc.timeline() for regression spotting
         self.slo = obs.slo.SLOTracker(self.metrics)
         self.timeline_ring = obs.slo.SnapshotTimeline()
+        # fleet capacity model (obs.capacity): per-worker μ from WRM
+        # histogram deltas, per-class λ from the admission tap, ρ/states
+        # with hysteresis, shard heat map, shadow scale/rebalance advice —
+        # evaluated each heartbeat, served by rpc.capacity()
+        self.capacity = obs.capacity.CapacityModel(
+            on_advice=self._record_capacity_advice
+        )
+        self.admission.arrival_observer = self._observe_arrival
+        self.metrics.gauge(
+            "bqueryd_tpu_capacity_fleet_utilization",
+            "fleet utilization estimate ρ (dispatch rate over aggregate "
+            "service rate, tempered by measured busy fractions)",
+            fn=lambda: self.capacity.fleet_gauge("utilization"),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_capacity_fleet_state",
+            "fleet saturation state code (0=ok 1=warm 2=saturated "
+            "3=overloaded, hysteresis applied)",
+            fn=lambda: self.capacity.fleet_gauge("state"),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_capacity_headroom_qps",
+            "estimated additional query arrival rate the fleet can absorb "
+            "before utilization crosses BQUERYD_TPU_CAPACITY_TARGET_RHO",
+            fn=lambda: self.capacity.fleet_gauge("headroom_qps"),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_capacity_model_drift",
+            "model-vs-measured queue-delay drift: (predicted - measured) / "
+            "max(both) — near 0 means the M/G/1 prediction tracks reality",
+            fn=lambda: self.capacity.fleet_gauge("model_drift"),
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_capacity_worker_resets",
+            "WRM counter restarts the capacity model detected and rebased "
+            "(worker processes restarting under the same node id)",
+            fn=self.capacity.worker_resets,
+        )
         self._worker_metrics = {}     # worker_id -> last histogram snapshot
         self._worker_metrics_rev = 0  # bumped on absorb/remove (cache key)
         self._worker_hist_cache = (-1, None)  # (rev, merged aggregate)
@@ -481,6 +527,12 @@ class ControllerNode:
         if now - self.last_heartbeat < self.heartbeat_interval:
             return
         self.last_heartbeat = now
+        # capacity model evaluation: per-worker/fleet ρ + states
+        # (hysteresis is wall-clock based, so the heartbeat cadence doesn't
+        # matter) + shadow advice; no-op under BQUERYD_TPU_CAPACITY=0.
+        # BEFORE the timeline snapshot, so every ring entry carries THIS
+        # beat's capacity slice (and the first entry is never empty)
+        self.capacity.evaluate(now=now)
         # controller timeline ring: one bounded registry snapshot per
         # BQUERYD_TPU_TIMELINE_INTERVAL_S (the ring paces itself; <=0
         # disables), served by rpc.timeline()
@@ -557,6 +609,7 @@ class ControllerNode:
             self.flight.record("worker_removed", worker=worker_id)
         self.worker_map.pop(worker_id, None)
         self.health.remove(worker_id)
+        self.capacity.remove_worker(worker_id)
         self._worker_wedged.pop(worker_id, None)
         if self._worker_metrics.pop(worker_id, None) is not None:
             self._worker_metrics_rev += 1
@@ -586,6 +639,20 @@ class ControllerNode:
         backend_wedged) into the health scorer, records wedge-latch flips
         in the flight ring, and absorbs the worker's debug-bundle slice."""
         snap = info.get("metrics")
+        wedged = bool(info.get("backend_wedged"))
+        # fleet capacity ingestion: μ from the service-histogram deltas +
+        # bottleneck stages from the pipeline busy clocks + the wedge
+        # latch (a wedged device's μ is excluded from fleet capacity).
+        # Calc workers only — downloaders serve no queries and would drag
+        # the model's coverage/μ averages.  Runs BEFORE the dedup below:
+        # deltas need the fresh cumulative totals every heartbeat,
+        # identical or not.
+        pipeline_busy = info.pop("pipeline_busy", None)
+        if info.get("workertype") == "calc" and isinstance(snap, dict):
+            self.capacity.absorb_worker(
+                worker_id, snap, pipeline_busy=pipeline_busy,
+                wedged=wedged, pid=info.get("pid"),
+            )
         if isinstance(snap, dict) and snap != self._worker_metrics.get(
             worker_id
         ):
@@ -597,7 +664,6 @@ class ControllerNode:
         # keep worker_map lean: the snapshot lives in _worker_metrics; a
         # second copy per worker entry would bloat get_info and peer gossip
         info.pop("metrics", None)
-        wedged = bool(info.get("backend_wedged"))
         prev_wedged = self._worker_wedged.get(worker_id)
         self._worker_wedged[worker_id] = wedged
         if wedged and not prev_wedged:
@@ -617,6 +683,7 @@ class ControllerNode:
             snapshot=self._worker_metrics.get(worker_id),
             wedged=wedged,
             errors=info.get("work_errors"),
+            pid=info.get("pid"),
         )
         debug = info.pop("debug", None)
         if isinstance(debug, dict):
@@ -952,6 +1019,9 @@ class ControllerNode:
             return
         if msg.isa("groupby"):
             self.counters["dispatched_shards"] += 1
+            # capacity model: per-worker λ window + the per-shard dispatch
+            # heat map (skew detection feeding the rebalance advice)
+            self.capacity.observe_dispatch(worker_id, msg.get("filename"))
         from bqueryd_tpu import obs
 
         # flight ring: every work envelope handed to a worker (hot path —
@@ -2060,6 +2130,40 @@ class ControllerNode:
 
         if obs.enabled():
             self.admission_wait_seconds.observe(wait_s)
+        # the capacity model's measured-wait cross-check has its own kill
+        # switch (BQUERYD_TPU_CAPACITY) — a queue-wait sample is capacity
+        # evidence whether or not the span hot path is on
+        self.capacity.observe_queue_wait(wait_s, source="admission")
+
+    def _observe_arrival(self, decision, payload):
+        """Admission's arrival tap: every offered groupby (ADMIT, QUEUED
+        and BUSY alike) lands in the capacity model's per-class arrival
+        window — λ is offered load, and shed load is what saturation looks
+        like."""
+        del decision  # offered load counts every outcome alike
+        msg = payload[0] if payload else None
+        slo_class = (
+            self.slo.resolve(msg.get("slo_class"))
+            if msg is not None else "default"
+        )
+        self.capacity.observe_arrival(slo_class)
+
+    def _record_capacity_advice(self, rec):
+        """Shadow-advisor sink: every NEW recommendation is a flight event
+        (ungated — advice changes are rare by construction) and a counter
+        bump.  Nothing acts on it; a later enforcement PR consumes these."""
+        action = rec.get("action")
+        counter_key = f"capacity_{action}_advised"
+        if counter_key in self.counters:
+            self.counters[counter_key] += 1
+        self.flight.record(
+            "capacity_advice",
+            action=action,
+            n=rec.get("n"),
+            shard=rec.get("shard"),
+            to_worker=rec.get("to_worker"),
+            reason=str(rec.get("reason"))[:200],
+        )
 
     def _timeline_snapshot(self):
         """One ``rpc.timeline()`` ring entry: the compact controller state
@@ -2079,6 +2183,21 @@ class ControllerNode:
             "groupby_p50_s": obs_metrics.quantile_from_snapshot(snap, 0.5),
             "groupby_p99_s": obs_metrics.quantile_from_snapshot(snap, 0.99),
             "slo": self.slo.snapshot(),
+            # fleet utilization/saturation per tick: the existing ring
+            # doubles as capacity history (was this cluster saturated an
+            # hour ago is one rpc.timeline() away)
+            "capacity": self._capacity_timeline_fields(),
+        }
+
+    def _capacity_timeline_fields(self):
+        """The compact capacity slice each timeline-ring entry carries."""
+        fleet = self.capacity.snapshot().get("fleet") or {}
+        return {
+            key: fleet.get(key)
+            for key in (
+                "utilization", "state", "arrival_qps", "knee_qps",
+                "headroom_qps", "model_drift",
+            )
         }
 
     @staticmethod
@@ -2180,6 +2299,20 @@ class ControllerNode:
             timeline["attribution"] = obs.slo.attribute(timeline)
         except Exception:
             self.logger.exception("attribution failed for %s", trace_id)
+        # capacity cross-check: the query's MEASURED pre-worker wait
+        # (admission_wait + dispatch segments — submit to worker send,
+        # exactly what the M/G/1 prediction models; retry backoff is
+        # failure-induced, not load-induced, and stays out) feeds the
+        # model's measured-wait EWMA, whose gap to the prediction is the
+        # model_drift gauge
+        attribution = timeline.get("attribution")
+        if isinstance(attribution, dict) and error is None:
+            segments = attribution.get("segments") or {}
+            self.capacity.observe_queue_wait(
+                segments.get("admission_wait", 0.0)
+                + segments.get("dispatch", 0.0),
+                source="autopsy",
+            )
         self.trace_store.put(trace_id, timeline)
         recorded = self.slow_queries.maybe_record(
             wall,
@@ -2385,6 +2518,18 @@ class ControllerNode:
         reply.add_as_binary("result", self.timeline_ring.entries())
         self.reply_rpc_message(msg.get("token"), reply)
 
+    def rpc_capacity(self, msg):
+        """``rpc.capacity()``: the fleet capacity model — per-worker μ/λ/ρ
+        and saturation state (hysteresis applied), fleet utilization,
+        predicted-vs-measured queue delay with the drift between them, the
+        per-shard dispatch heat map, headroom QPS / the predicted
+        saturation knee, and the shadow advisor's current recommendations
+        with their evidence.  Advisory only: nothing here is acted on."""
+        self.capacity.evaluate()
+        reply = msg.copy()
+        reply.add_as_binary("result", self.capacity.snapshot())
+        self.reply_rpc_message(msg.get("token"), reply)
+
     def rpc_health(self, msg):
         """Per-worker health statuses (ok/degraded/wedged) from the rolling
         latency/error baselines — the view dispatch routing acts on."""
@@ -2402,7 +2547,7 @@ class ControllerNode:
 
     def rpc_debug_bundle(self, msg):
         """``rpc.debug_bundle(trace_id=None)``: the cross-node forensic
-        artifact (schema ``bqueryd_tpu.debug_bundle/2``) — flight rings,
+        artifact (schema ``bqueryd_tpu.debug_bundle/3``) — flight rings,
         the requested (or newest) trace timeline, metrics and slow-query
         snapshots, per-worker compile registries and device health.  One
         JSON-safe dict you can attach to a bug report; dead peers degrade
@@ -2470,6 +2615,11 @@ class ControllerNode:
             "batch_window": self._batch_window_info(),
             "slo": self.slo.snapshot(),
             "timeline_ring": self.timeline_ring.entries()[-16:],
+            # the fleet capacity model (PR 12): per-worker μ/ρ/state, shard
+            # heat map, predicted-vs-measured queue delay, last shadow
+            # recommendations — freshly evaluated, the bundle must not
+            # ship a stale saturation verdict
+            "capacity": self._capacity_bundle_section(),
         }
         snapshots = {}
         for worker_id in set(self.worker_map) | set(self._worker_debug):
@@ -2572,6 +2722,13 @@ class ControllerNode:
                 if len(holders) < (self.replica_factor or 2)
             )[:64],
         }
+
+    def _capacity_bundle_section(self):
+        """The debug bundle's capacity slice: a fresh evaluation (a bundle
+        pulled during an incident must carry the live saturation verdict,
+        not the last heartbeat's)."""
+        self.capacity.evaluate()
+        return self.capacity.snapshot()
 
     def _batch_window_info(self):
         """Micro-batch window state for the debug bundle: the live knobs
@@ -3085,6 +3242,10 @@ class ControllerNode:
                         node=self.address,
                     )
                 )
+        # capacity model: one LAUNCHED query — the shards-per-query
+        # denominator counts runs that actually open (shed/expired/
+        # superseded offers never reach here)
+        self.capacity.observe_launch()
         segment = {
             "client_token": msg["token"],
             "msg": msg,

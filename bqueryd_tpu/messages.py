@@ -147,6 +147,11 @@ ENVELOPE_SCHEMA = {
     "calibration": "measured-cost strategy calibration summary "
                    "(plan.calibrate cells, absorbed controller-side)",
     "metrics": "histogram snapshot (bucket-vector mergeable)",
+    "pipeline_busy": "cumulative per-stage StageClock busy seconds "
+                     "(parallel.pipeline snapshot) — the controller's "
+                     "capacity model (obs.capacity) derives per-stage busy "
+                     "deltas from it to name each worker's bottleneck "
+                     "stage; None for non-calc roles",
     "liveness_only": "heartbeat-thread WRM: skip data_files rescan",
     # controller gossip + bookkeeping riders
     "from": "gossiping controller address",

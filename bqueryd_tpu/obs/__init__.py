@@ -42,6 +42,17 @@ PR 10 adds the accounting tier on top of the spans:
   (``bqueryd_tpu_slo_*`` margin histograms + burn-rate gauges), and the
   bounded controller snapshot ring behind ``rpc.timeline()``.
 
+PR 12 adds the fleet tier:
+
+* :mod:`.capacity` — the controller-resident queueing-model capacity
+  accounting behind ``rpc.capacity()``: per-worker service rate μ from WRM
+  histogram deltas (restart-reset guarded), per-class arrival rate λ from
+  the admission tap, ρ = λ/μ with an M/G/1 predicted queue delay
+  cross-checked against measured waits, ok/warm/saturated/overloaded
+  states with hysteresis, a per-shard dispatch heat map, headroom-QPS /
+  saturation-knee estimation, and a shadow scale_up/scale_down/rebalance
+  advisor (logged, counted, never acted on).
+
 The hot path (span recording + histogram observes + flight envelope events
 + compile-call accounting) can be disabled with ``BQUERYD_TPU_METRICS=0``
 (or :func:`set_enabled`) — bench.py measures the enabled-vs-disabled delta
@@ -96,6 +107,7 @@ from bqueryd_tpu.obs.health import (  # noqa: F401
     STATUS_WEDGED,
     HealthScorer,
 )
+from bqueryd_tpu.obs import capacity  # noqa: F401
 from bqueryd_tpu.obs import profile  # noqa: F401
 from bqueryd_tpu.obs import slo  # noqa: F401
 

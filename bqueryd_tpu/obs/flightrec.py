@@ -36,9 +36,13 @@ import time
 #: store summary, PR 6), ``chaos`` (fault-injection stats, PR 8),
 #: ``replication`` (replica placement, PR 8), ``batch_window`` (micro-batch
 #: staging state, PR 9), ``slo`` (per-class accounting), ``timeline_ring``
-#: (periodic registry snapshots).  /1 consumers keep working: nothing was
-#: removed or renamed.
-BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/2"
+#: (periodic registry snapshots).
+#: schema /3 (PR 12): additive ``capacity`` controller-section key — the
+#: fleet capacity model's freshly-evaluated snapshot (per-worker μ/ρ/state,
+#: shard heat map, predicted-vs-measured queue delay, last shadow
+#: recommendations; see obs.capacity).  /1 and /2 consumers keep working:
+#: nothing was removed or renamed.
+BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/3"
 
 DEFAULT_CAPACITY = 512
 DEFAULT_MAX_BYTES = 1 << 20  # 1 MiB of ring per node

@@ -81,6 +81,7 @@ class HealthScorer:
         self._lock = threading.Lock()
         self._samples = {}   # worker_id -> deque[(ts, count, sum, errors)]
         self._wedged = {}    # worker_id -> bool (latest advertised latch)
+        self._pids = {}      # worker_id -> advertised pid (exact restarts)
         # statuses() is on the dispatch hot path (one call per placed
         # shard) but its inputs change only on observe/remove (heartbeat
         # cadence): memoize on a revision counter, same pattern as the
@@ -89,7 +90,7 @@ class HealthScorer:
         self._statuses_cache = (-1, None)
 
     def observe(self, worker_id, snapshot=None, wedged=False, errors=None,
-                now=None):
+                pid=None, now=None):
         """Fold one WRM's worth of signals in (idempotent per heartbeat:
         identical cumulative totals just extend the window)."""
         now = time.time() if now is None else now
@@ -102,6 +103,31 @@ class HealthScorer:
             window = self._samples.setdefault(
                 worker_id, collections.deque()
             )
+            last_pid = self._pids.get(worker_id)
+            if pid is not None:
+                self._pids[worker_id] = pid
+            if window and pid is not None and last_pid is not None \
+                    and pid != last_pid:
+                # exact restart signal (the WRM advertises the pid):
+                # rebase even when the totals alone wouldn't prove it
+                window.clear()
+            if window:
+                _ts, lcount, ltotal, lerr = window[-1]
+                if count < lcount and count <= lcount // 2:
+                    # the worker process restarted under the same node id:
+                    # its cumulative histogram/counter totals reset to
+                    # zero.  The window deltas assume monotonicity — left
+                    # alone, max(last-first, 0) would clamp this worker's
+                    # windowed throughput/error rate to 0 until the
+                    # pre-restart samples age out, hiding a genuinely slow
+                    # or erroring restarted worker.  Rebase: drop the
+                    # pre-restart samples and let the fresh process build
+                    # its own baseline (innocent until measured, same as a
+                    # brand-new worker).  The halving test keeps slightly
+                    # out-of-order snapshots from the worker's two WRM
+                    # streams (main loop + liveness thread) from reading
+                    # as restarts — those deltas already clamp at 0.
+                    window.clear()
             window.append((now, count, total, errors))
             cutoff = now - self.window_s
             while len(window) > 1 and window[0][0] < cutoff:
@@ -113,6 +139,7 @@ class HealthScorer:
         with self._lock:
             self._samples.pop(worker_id, None)
             self._wedged.pop(worker_id, None)
+            self._pids.pop(worker_id, None)
             self._rev += 1
 
     def _window_stats(self, window):

@@ -65,6 +65,13 @@ class AdmissionController:
         #: that launches from the queue (the controller wires a latency
         #: histogram here; this module stays metrics-agnostic)
         self.wait_observer = None
+        #: arrival-rate tap: called as ``arrival_observer(decision,
+        #: payload)`` for every NON-duplicate submission — ADMIT, QUEUED
+        #: and BUSY all count (λ is *offered* load; shed load is what
+        #: saturation looks like).  The controller wires the capacity
+        #: model's per-class arrival window here; this module stays
+        #: metrics-agnostic.
+        self.arrival_observer = None
         # lifetime totals (stats()): the registry counters mirror these via
         # the controller's counters dict; kept here too so a bare
         # AdmissionController remains self-describing in tests/tools
@@ -82,6 +89,16 @@ class AdmissionController:
             self._client_load[client] = n
 
     # -- surface -------------------------------------------------------------
+    def _notify_arrival(self, decision, payload):
+        """Fire the arrival tap; an observer failure must never break
+        admission (same contract as wait_observer)."""
+        if self.arrival_observer is not None:
+            try:
+                self.arrival_observer(decision, payload)
+            except Exception:
+                pass
+        return decision
+
     def submit(self, ticket_id, client, priority=0, deadline=None,
                payload=None):
         """Returns ADMIT (run now), QUEUED (held), BUSY (rejected), or
@@ -93,15 +110,15 @@ class AdmissionController:
             self._client_load.get(client, 0) >= self.client_quota
         ):
             self.total_busy += 1
-            return BUSY
+            return self._notify_arrival(BUSY, payload)
         if len(self._active) < self.max_active:
             self._active[ticket_id] = client
             self._charge(client, +1)
             self.total_admitted += 1
-            return ADMIT
+            return self._notify_arrival(ADMIT, payload)
         if len(self._queued) >= self.queue_depth:
             self.total_busy += 1
-            return BUSY
+            return self._notify_arrival(BUSY, payload)
         entry = (
             float(priority or 0),
             float(deadline) if deadline is not None else float("inf"),
@@ -113,7 +130,7 @@ class AdmissionController:
         heapq.heappush(self._heap, entry)
         self._charge(client, +1)
         self.total_queued += 1
-        return QUEUED
+        return self._notify_arrival(QUEUED, payload)
 
     def pop_ready(self, now=None):
         """Drain the queue into capacity.  Returns ``(launch, expired)``:

@@ -378,6 +378,20 @@ class RPC:
                 record["coverage"] = round(covered / wall, 4)
         return record
 
+    # -- fleet capacity ----------------------------------------------------
+    def capacity(self):
+        """The controller's fleet capacity model (``obs.capacity``): per
+        worker μ (service rate), λ (dispatch rate), ρ and saturation state
+        (ok/warm/saturated/overloaded, hysteresis applied); fleet
+        utilization, the predicted saturation knee / headroom QPS, the
+        M/G/1-predicted vs measured queue delay and their drift; the
+        per-shard dispatch heat map; and the shadow advisor's current
+        ``scale_up``/``scale_down``/``rebalance`` recommendations with
+        their evidence.  Advisory only — the controller never acts on
+        them.  (An explicit method rather than the ``__getattr__`` proxy
+        purely for discoverability; the verb is plain ``capacity``.)"""
+        return self._rpc("capacity", (), {})
+
     # -- download helpers (client-local, straight to the store) ------------
     def get_download_data(self):
         """Raw ticket hashes keyed by their full store key — the reference's

@@ -494,6 +494,23 @@ class WorkerBase:
         except Exception:
             return None
 
+    def _pipeline_busy_to_advertise(self):
+        """The StageClock busy snapshot riding calc WRMs: the controller's
+        capacity model (obs.capacity) reads per-stage busy DELTAS from it
+        to name each worker's bottleneck stage (decode vs kernel vs merge)
+        beside its utilization.  Cumulative totals — the absorb side
+        rebases on a restart's reset, same contract as the histogram
+        snapshot.  None for non-calc roles (no data path, no stages) and
+        on any failure: busy accounting must never break liveness."""
+        if getattr(self, "workertype", None) != "calc":
+            return None
+        try:
+            from bqueryd_tpu.parallel import pipeline
+
+            return pipeline.clock().snapshot()
+        except Exception:
+            return None
+
     def prepare_wrm(self):
         # getattr defence: embedders and tests build workers piecemeal
         # (__new__), and a missing registry must never break the WRM
@@ -544,6 +561,10 @@ class WorkerBase:
                     registry.histogram_snapshot()
                     if registry is not None else None
                 ),
+                # per-stage pipeline busy clocks (cumulative seconds): the
+                # capacity model's bottleneck-stage signal; None for
+                # non-calc roles
+                "pipeline_busy": self._pipeline_busy_to_advertise(),
             }
         )
 

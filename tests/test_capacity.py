@@ -1,0 +1,692 @@
+"""Fleet capacity telemetry (PR 12): the queueing-model saturation
+accounting in obs.capacity — μ/λ/ρ estimation, WRM-reset robustness, state
+hysteresis, the M/G/1 prediction + drift, shard heat / skew detection, the
+shadow advisor, the rpc.capacity() verb, timeline-ring capacity fields, and
+the worker-restart-mid-burst regression."""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bqueryd_tpu.obs import capacity
+from tests.conftest import wait_until
+
+
+def svc_snapshot(count, total, buckets=(0.05, 0.1, 0.25, 0.5),
+                 counts=None):
+    """A WRM histogram snapshot with the given cumulative service totals."""
+    if counts is None:
+        counts = [count] + [0] * len(buckets)
+    return {
+        capacity.SERVICE_FAMILY: [
+            {"buckets": list(buckets), "counts": counts, "sum": total}
+        ]
+    }
+
+
+@pytest.fixture()
+def fast_knobs(monkeypatch):
+    """No hysteresis, a short window: unit tests exercise transitions
+    without wall-clock waits."""
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_HYSTERESIS_S", "0")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_WINDOW_S", "30")
+
+
+# -- service-rate estimation ---------------------------------------------------
+
+def test_service_totals_parses_and_defends():
+    count, total, bounds, counts = capacity.service_totals(
+        svc_snapshot(7, 1.4)
+    )
+    assert (count, total) == (7, 1.4)
+    assert bounds and len(counts) == len(bounds) + 1
+    assert capacity.service_totals({})[0] == 0
+    assert capacity.service_totals({capacity.SERVICE_FAMILY: "junk"})[0] == 0
+    assert capacity.service_totals(None if False else {"x": 1})[0] == 0
+
+
+def test_mu_from_histogram_deltas(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker("w", svc_snapshot(0, 0.0), now=now)
+    # 10 completions per beat, 0.1 s each -> mu = 10/s
+    for i in range(1, 4):
+        m.absorb_worker("w", svc_snapshot(i * 10, i * 1.0), now=now + i)
+    result = m.evaluate(now=now + 4)
+    w = result["workers"]["w"]
+    assert w["mu"] == pytest.approx(10.0, rel=0.01)
+    assert w["mean_service_s"] == pytest.approx(0.1, rel=0.01)
+    assert w["samples"] == 30
+    assert w["resets"] == 0
+
+
+def test_restart_reset_rebases_not_poisons(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker("w", svc_snapshot(0, 0.0), now=now)
+    m.absorb_worker("w", svc_snapshot(40, 4.0), now=now + 1)
+    mu_before = m.evaluate(now=now + 1)["workers"]["w"]["mu"]
+    # the worker process restarts under the same node id: totals near zero
+    m.absorb_worker("w", svc_snapshot(2, 0.2), now=now + 2)
+    result = m.evaluate(now=now + 2)
+    w = result["workers"]["w"]
+    assert w["resets"] == 1
+    assert m.worker_resets() == 1
+    # μ survives the restart untouched (EWMA kept, baseline rebased)
+    assert w["mu"] == pytest.approx(mu_before, rel=0.01)
+    # post-restart beats resume measuring from the rebased baseline
+    m.absorb_worker("w", svc_snapshot(12, 1.2), now=now + 3)
+    assert m.evaluate(now=now + 3)["workers"]["w"]["samples"] == 50
+
+
+def test_out_of_order_snapshot_is_not_a_restart(fast_knobs):
+    """The worker's two WRM streams can deliver snapshots slightly out of
+    order; a barely-backwards total is a stale sample to drop, not a
+    restart to rebase on."""
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker("w", svc_snapshot(0, 0.0), now=now)
+    m.absorb_worker("w", svc_snapshot(40, 4.0), now=now + 1)
+    m.absorb_worker("w", svc_snapshot(39, 3.9), now=now + 1.01)  # stale
+    assert m.evaluate(now=now + 2)["workers"]["w"]["resets"] == 0
+    # the baseline stayed at 40: the next real beat's delta is 10, not 11
+    m.absorb_worker("w", svc_snapshot(50, 5.0), now=now + 2)
+    assert m.evaluate(now=now + 2)["workers"]["w"]["samples"] == 50
+
+
+def test_idle_heartbeats_leave_moments_alone(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker("w", svc_snapshot(0, 0.0), now=now)
+    m.absorb_worker("w", svc_snapshot(10, 1.0), now=now + 1)
+    mean = m.evaluate(now=now + 1)["workers"]["w"]["mean_service_s"]
+    for i in range(2, 5):
+        m.absorb_worker("w", svc_snapshot(10, 1.0), now=now + i)
+    assert m.evaluate(now=now + 5)["workers"]["w"][
+        "mean_service_s"
+    ] == mean
+
+
+def test_cv2_from_bucket_spread(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    buckets = (0.01, 0.1, 1.0)
+    m.absorb_worker(
+        "w", svc_snapshot(0, 0.0, buckets, [0, 0, 0, 0]), now=now
+    )
+    # half the completions fast, half slow: high dispersion
+    m.absorb_worker(
+        "w", svc_snapshot(10, 1.5, buckets, [5, 0, 5, 0]), now=now + 1
+    )
+    w = m.evaluate(now=now + 1)["workers"]["w"]
+    assert w["cv2"] > 0.5
+
+
+def test_pipeline_busy_bottleneck_and_reset_guard(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker(
+        "w", svc_snapshot(0, 0.0),
+        pipeline_busy={"busy_seconds": {"kernel": 1.0, "decode": 0.2}},
+        now=now,
+    )
+    m.absorb_worker(
+        "w", svc_snapshot(5, 1.0),
+        pipeline_busy={"busy_seconds": {"kernel": 3.0, "decode": 0.4}},
+        now=now + 1,
+    )
+    assert m.evaluate(now=now + 1)["workers"]["w"][
+        "bottleneck_stage"
+    ] == "kernel"
+    # stage clocks reset (restart): the window delta is dropped, never
+    # negative
+    m.absorb_worker(
+        "w", svc_snapshot(1, 0.2),
+        pipeline_busy={"busy_seconds": {"kernel": 0.1, "decode": 0.9}},
+        now=now + 2,
+    )
+    m.absorb_worker(
+        "w", svc_snapshot(2, 0.4),
+        pipeline_busy={"busy_seconds": {"kernel": 0.2, "decode": 2.0}},
+        now=now + 3,
+    )
+    assert m.evaluate(now=now + 3)["workers"]["w"][
+        "bottleneck_stage"
+    ] == "decode"
+    # a slightly-backwards stage total (stale snapshot from the worker's
+    # other WRM stream) is dropped, not treated as a restart: the EWMA and
+    # baseline survive and the label holds
+    m.absorb_worker(
+        "w", svc_snapshot(3, 0.6),
+        pipeline_busy={"busy_seconds": {"kernel": 0.19, "decode": 1.99}},
+        now=now + 4,
+    )
+    assert m.evaluate(now=now + 4)["workers"]["w"][
+        "bottleneck_stage"
+    ] == "decode"
+
+
+# -- windows, states, hysteresis ----------------------------------------------
+
+def test_rate_window_cold_start_and_trim():
+    w = capacity._RateWindow(bucket_s=1.0)
+    now = 1000.0
+    for i in range(4):
+        w.add(now + i, 2)
+    # 8 events over ~4 s of observed life, not diluted over the horizon
+    assert w.rate(now + 3.5, 60.0) == pytest.approx(8 / 3.5, rel=0.05)
+    # far in the future everything expires (and trims)
+    assert w.rate(now + 1000, 60.0) == 0.0
+    assert w.buckets == {}
+
+
+def test_classify_thresholds(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_WARM", "0.5")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_SATURATED", "0.8")
+    assert capacity.classify(None) == "ok"
+    assert capacity.classify(0.3) == "ok"
+    assert capacity.classify(0.6) == "warm"
+    assert capacity.classify(0.9) == "saturated"
+    assert capacity.classify(1.2) == "overloaded"
+
+
+def test_hysteresis_holds_then_flips():
+    h = capacity._Hysteresis()
+    now = 100.0
+    assert h.update("saturated", now, hold_s=5.0) == "ok"
+    assert h.update("saturated", now + 3, hold_s=5.0) == "ok"
+    # a flap back resets the pending clock
+    assert h.update("ok", now + 4, hold_s=5.0) == "ok"
+    assert h.update("saturated", now + 5, hold_s=5.0) == "ok"
+    assert h.update("saturated", now + 10.1, hold_s=5.0) == "saturated"
+    # hold 0 flips immediately
+    assert h.update("ok", now + 11, hold_s=0.0) == "ok"
+
+
+# -- fleet derivation ----------------------------------------------------------
+
+def _warm_model(m, now, qps=8, mu_per_worker=10, workers=("w1", "w2"),
+                beats=5, shards=("s0", "s1")):
+    """Drive a synthetic steady state: the fleet receives ``qps``
+    arrivals/s split across workers/shards; each worker COMPLETES its
+    share at mean service 1/μ (so its busy fraction tracks its load —
+    serving 4/s at μ=10 is 40% busy, not flat out)."""
+    served = max(qps // len(workers), 1)
+    for w in workers:
+        m.absorb_worker(w, svc_snapshot(0, 0.0), now=now)
+    for i in range(1, beats + 1):
+        t = now + i
+        for w in workers:
+            m.absorb_worker(
+                w,
+                svc_snapshot(i * served, i * served / mu_per_worker),
+                now=t,
+            )
+        for q in range(qps):
+            m.observe_arrival("default", now=t)
+            m.observe_launch(now=t)
+            m.observe_dispatch(
+                workers[q % len(workers)], [shards[q % len(shards)]],
+                now=t,
+            )
+    return now + beats
+
+
+def test_fleet_knee_headroom_and_coverage(fast_knobs):
+    m = capacity.CapacityModel()
+    t = _warm_model(m, time.time(), qps=8, mu_per_worker=10)
+    fleet = m.evaluate(now=t)["fleet"]
+    assert fleet["coverage"] == 1.0
+    assert fleet["arrival_qps"] == pytest.approx(8.0, rel=0.2)
+    assert fleet["shards_per_query"] == pytest.approx(1.0, rel=0.05)
+    # knee = Σμ / spq = 20 qps; headroom = knee * target_rho - λ
+    assert fleet["knee_qps"] == pytest.approx(20.0, rel=0.05)
+    expected_headroom = 20.0 * capacity.target_rho() - fleet["arrival_qps"]
+    assert fleet["headroom_qps"] == pytest.approx(
+        expected_headroom, rel=0.1
+    )
+    assert fleet["mu_dispatches_per_s"] == pytest.approx(20.0, rel=0.05)
+
+
+def test_mg1_prediction_measured_and_drift(fast_knobs):
+    m = capacity.CapacityModel()
+    t = _warm_model(m, time.time(), qps=8, mu_per_worker=10)
+    for _ in range(4):
+        m.observe_queue_wait(0.02)
+    fleet = m.evaluate(now=t)["fleet"]
+    assert fleet["predicted_queue_delay_s"] is not None
+    assert fleet["predicted_queue_delay_s"] > 0
+    assert fleet["measured_queue_delay_s"] == pytest.approx(0.02, rel=0.05)
+    assert fleet["model_drift"] is not None
+    assert -1.0 <= fleet["model_drift"] <= 1.0
+
+
+def test_remove_worker_shrinks_fleet_mu(fast_knobs):
+    m = capacity.CapacityModel()
+    t = _warm_model(m, time.time(), qps=4, mu_per_worker=10)
+    before = m.evaluate(now=t)["fleet"]["mu_dispatches_per_s"]
+    m.remove_worker("w2")
+    after = m.evaluate(now=t)["fleet"]
+    assert after["mu_dispatches_per_s"] == pytest.approx(
+        before / 2, rel=0.05
+    )
+    assert after["workers"] == 1
+
+
+# -- the shadow advisor --------------------------------------------------------
+
+def test_advisor_scale_up_at_saturation(fast_knobs):
+    m = capacity.CapacityModel()
+    # λ 16/s against fleet μ 8/s: overloaded
+    t = _warm_model(
+        m, time.time(), qps=16, mu_per_worker=4, workers=("w1", "w2")
+    )
+    result = m.evaluate(now=t)
+    assert result["fleet"]["state"] == "overloaded"
+    recs = result["recommendations"]
+    assert recs and recs[0]["action"] == "scale_up"
+    # 16 dispatches/s at μ=4 per worker and target ρ 0.7 needs ~6 workers
+    assert recs[0]["n"] >= 3
+    assert recs[0]["evidence"]["workers"] == 2
+
+
+def test_advisor_silent_when_idle_and_at_low_load(fast_knobs):
+    m = capacity.CapacityModel()
+    now = time.time()
+    # workers present, zero traffic: no evidence, no advice (and
+    # especially no scale_down loop on an idle cluster)
+    for w in ("w1", "w2"):
+        m.absorb_worker(w, svc_snapshot(0, 0.0), now=now)
+        m.absorb_worker(w, svc_snapshot(10, 1.0), now=now + 1)
+    assert m.evaluate(now=now + 1)["recommendations"] == []
+    # light load on ONE worker: ok state, nothing to advise
+    m2 = capacity.CapacityModel()
+    t = _warm_model(
+        m2, now, qps=2, mu_per_worker=10, workers=("w1",), shards=("s0",)
+    )
+    result = m2.evaluate(now=t)
+    assert result["fleet"]["state"] == "ok"
+    assert result["recommendations"] == []
+
+
+def test_advisor_scale_down_when_overprovisioned(fast_knobs):
+    m = capacity.CapacityModel()
+    t = _warm_model(
+        m, time.time(), qps=2, mu_per_worker=10,
+        workers=("w1", "w2", "w3", "w4"),
+    )
+    result = m.evaluate(now=t)
+    assert result["fleet"]["state"] == "ok"
+    recs = result["recommendations"]
+    assert recs and recs[0]["action"] == "scale_down"
+    assert 1 <= recs[0]["n"] <= 3
+    assert recs[0]["evidence"]["workers_needed"] >= 1
+
+
+def test_advisor_rebalance_on_shard_skew(fast_knobs, monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_SATURATED", "0.6")
+    m = capacity.CapacityModel()
+    now = time.time()
+    for w in ("hot", "cool"):
+        m.absorb_worker(w, svc_snapshot(0, 0.0), now=now)
+    for i in range(1, 6):
+        t = now + i
+        # hot serves 10/s flat out; cool serves 1/s with idle room
+        m.absorb_worker("hot", svc_snapshot(i * 10, i * 1.0), now=t)
+        m.absorb_worker("cool", svc_snapshot(i * 1, i * 0.02), now=t)
+        for q in range(10):
+            m.observe_arrival("default", now=t)
+            m.observe_dispatch("hot", ["s_hot"], now=t)
+        m.observe_dispatch("cool", ["s_a"], now=t)
+        # cold shards exist so the skew has a uniform share to beat
+        for shard in ("s_b", "s_c"):
+            m.observe_dispatch("cool", [shard], now=t)
+    result = m.evaluate(now=now + 5)
+    actions = {r["action"]: r for r in result["recommendations"]}
+    assert "rebalance" in actions
+    reb = actions["rebalance"]
+    assert reb["shard"] == "s_hot"
+    assert reb["to_worker"] == "cool"
+    assert reb["evidence"]["skew"] >= capacity.SHARD_SKEW_FACTOR
+    heat = result["shard_heat"]
+    assert heat[0]["shard"] == "s_hot" and heat[0]["share"] > 0.5
+
+
+def test_advice_emitted_once_per_change_and_counted(fast_knobs):
+    emitted = []
+    m = capacity.CapacityModel(on_advice=emitted.append)
+    t = _warm_model(
+        m, time.time(), qps=16, mu_per_worker=4, workers=("w1", "w2")
+    )
+    m.evaluate(now=t)
+    m.evaluate(now=t + 0.1)     # unchanged advice: no re-emit
+    assert len(emitted) == 1
+    assert emitted[0]["action"] == "scale_up"
+    assert m.advice_count("scale_up") == 1
+    assert m.evaluate(now=t)["advice_counts"]["scale_up"] == 1
+    # a still-standing scale_up whose sizing `n` flaps (ceil quantization
+    # near a boundary) must NOT re-emit: more load arrives, n grows, the
+    # recommendation stands — one emission total
+    for i in range(10):
+        m.observe_arrival("default", now=t)
+        m.observe_dispatch("w1", ["s0"], now=t)
+    result = m.evaluate(now=t + 0.2)
+    assert result["recommendations"][0]["action"] == "scale_up"
+    assert len(emitted) == 1
+    assert m.advice_count("scale_up") == 1
+
+
+def test_shed_offers_do_not_inflate_the_knee(fast_knobs):
+    """Offers that never launch (BUSY shed, queued-then-expired,
+    superseded) count toward λ (offered load) but not toward the
+    shards-per-query denominator — shedding must not make the knee read
+    higher exactly when the cluster is saturated."""
+    m = capacity.CapacityModel()
+    t = _warm_model(m, time.time(), qps=8, mu_per_worker=10)
+    knee_before = m.evaluate(now=t)["fleet"]["knee_qps"]
+    # a burst of shed offers: arrivals with no launch behind them
+    for _ in range(40):
+        m.observe_arrival("default", now=t)
+    fleet = m.evaluate(now=t)["fleet"]
+    assert fleet["arrival_qps"] > fleet["launched_qps"]
+    assert fleet["knee_qps"] == pytest.approx(knee_before, rel=0.01)
+
+
+def test_pid_change_is_an_exact_restart_signal(fast_knobs):
+    """A restart the halving heuristic would miss (the old count was
+    small, the new one already past half) still rebases when the WRM's
+    advertised pid changed — no cross-restart delta ever reaches μ."""
+    m = capacity.CapacityModel()
+    now = time.time()
+    m.absorb_worker("w", svc_snapshot(0, 0.0), pid=100, now=now)
+    m.absorb_worker("w", svc_snapshot(4, 0.4), pid=100, now=now + 1)
+    # restarted process already served 3 (3 > 4//2: heuristic blind)
+    m.absorb_worker("w", svc_snapshot(3, 9.0), pid=200, now=now + 2)
+    w = m.evaluate(now=now + 2)["workers"]["w"]
+    assert w["resets"] == 1
+    # the 9.0s cross-restart sum never poisoned the mean (still 0.1)
+    assert w["mean_service_s"] == pytest.approx(0.1, rel=0.01)
+    # post-restart deltas measure from the rebased baseline
+    m.absorb_worker("w", svc_snapshot(13, 10.0), pid=200, now=now + 3)
+    assert m.evaluate(now=now + 3)["workers"]["w"]["samples"] == 14
+
+
+def test_advisor_sizes_against_usable_workers(fast_knobs):
+    """scale_up sizing counts only usable (measured, non-wedged) workers:
+    2 of 4 wedged means the gap is measured from 2, not 4."""
+    m = capacity.CapacityModel()
+    now = time.time()
+    workers = ("w1", "w2", "w3", "w4")
+    for w in workers:
+        m.absorb_worker(w, svc_snapshot(0, 0.0), now=now)
+    for i in range(1, 6):
+        t = now + i
+        for w in workers:
+            m.absorb_worker(
+                w, svc_snapshot(i * 4, i * 1.0),
+                wedged=w in ("w3", "w4"), now=t,
+            )
+        for q in range(14):
+            m.observe_arrival("default", now=t)
+            m.observe_launch(now=t)
+            m.observe_dispatch(workers[q % 2], ["s0"], now=t)
+    result = m.evaluate(now=now + 5)
+    assert result["fleet"]["workers"] == 4
+    assert result["fleet"]["measured_workers"] == 2
+    recs = [r for r in result["recommendations"]
+            if r["action"] == "scale_up"]
+    assert recs, result["recommendations"]
+    # λ=14 dispatches/s at μ=4/worker, target 0.7: needs ceil(5) = 5
+    # usable workers; with 2 usable the ask is 3, not 1
+    assert recs[0]["n"] == 3
+    assert recs[0]["evidence"]["usable_workers"] == 2
+
+
+# -- kill switch + surfaces ----------------------------------------------------
+
+def test_kill_switch_disables_taps_and_evaluate(monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY", "0")
+    m = capacity.CapacityModel()
+    m.absorb_worker("w", svc_snapshot(10, 1.0))
+    m.observe_arrival()
+    m.observe_dispatch("w", ["s"])
+    m.observe_queue_wait(1.0)
+    assert m.evaluate() == {}
+    snap = m.snapshot()
+    assert snap["enabled"] is False
+    assert "workers" not in snap or not snap.get("workers")
+
+
+def test_fleet_gauges_and_snapshot_json_safe(fast_knobs):
+    m = capacity.CapacityModel()
+    t = _warm_model(m, time.time(), qps=16, mu_per_worker=4)
+    m.evaluate(now=t)
+    assert m.fleet_gauge("state") == capacity.STATE_CODES["overloaded"]
+    assert m.fleet_gauge("utilization") > 1.0
+    assert m.fleet_gauge("headroom_qps") == 0.0
+    json.dumps(m.snapshot())  # must be JSON-safe end to end
+
+
+# -- health scorer restart regression (satellite) ------------------------------
+
+def test_health_scorer_rebases_on_worker_restart():
+    from bqueryd_tpu.obs.health import HealthScorer
+
+    scorer = HealthScorer(window_s=300.0)
+    now = time.time()
+
+    def snap(count, total):
+        return {
+            "bqueryd_tpu_worker_groupby_seconds": [
+                {"counts": [count], "sum": total}
+            ]
+        }
+
+    scorer.observe("w", snapshot=snap(0, 0.0), errors=0, now=now)
+    scorer.observe("w", snapshot=snap(40, 4.0), errors=2, now=now + 1)
+    assert scorer.statuses()["w"]["queries"] == 40
+    # restart: totals reset to zero; the window must rebase, and the next
+    # delta must reflect the restarted process's real throughput instead
+    # of clamping to 0 until the pre-restart samples age out
+    scorer.observe("w", snapshot=snap(0, 0.0), errors=0, now=now + 2)
+    scorer.observe("w", snapshot=snap(30, 9.0), errors=0, now=now + 3)
+    stats = scorer.statuses()["w"]
+    assert stats["queries"] == 30
+    assert stats["mean_latency_s"] == pytest.approx(0.3, rel=0.01)
+    # a slightly out-of-order snapshot is NOT a restart: the window keeps
+    # its post-restart baseline (a one-off ±1 in the first-vs-last delta,
+    # not a rebase to an empty window)
+    scorer.observe("w", snapshot=snap(29, 8.7), errors=0, now=now + 3.01)
+    assert scorer.statuses()["w"]["queries"] >= 29
+
+
+# -- e2e: cluster --------------------------------------------------------------
+
+def _start(*nodes):
+    threads = [
+        threading.Thread(target=node.go, daemon=True) for node in nodes
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _stop(nodes, threads):
+    for node in nodes:
+        if node is not None:
+            node.running = False
+    for t in threads:
+        t.join(timeout=5)
+
+
+@pytest.fixture()
+def capacity_cluster(tmp_path, mem_store_url, monkeypatch):
+    """Controller + one worker over two shards with fast capacity knobs."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_HYSTERESIS_S", "0")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_WINDOW_S", "30")
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame({
+        "g": rng.integers(0, 5, 2000).astype(np.int64),
+        "v": rng.integers(-1000, 1000, 2000).astype(np.int64),
+    })
+    shards = ["cap_0.bcolzs", "cap_1.bcolzs"]
+    for i, name in enumerate(shards):
+        ctable.fromdataframe(
+            df.iloc[i::2].reset_index(drop=True), str(tmp_path / name)
+        )
+    controller = ControllerNode(
+        coordination_url=mem_store_url, loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path), heartbeat_interval=0.05,
+    )
+    worker = WorkerNode(
+        coordination_url=mem_store_url, data_dir=str(tmp_path),
+        loglevel=logging.WARNING, restart_check=False,
+        heartbeat_interval=0.1, poll_timeout=0.05,
+    )
+    threads = _start(controller, worker)
+    wait_until(
+        lambda: all(name in controller.files_map for name in shards),
+        desc="shards advertised",
+    )
+    expected = df.groupby("g")["v"].sum().to_dict()
+    yield {
+        "controller": controller, "worker": worker, "shards": shards,
+        "url": mem_store_url, "tmp_path": tmp_path, "expected": expected,
+    }
+    _stop([controller, worker], threads)
+
+
+def _ask(url, shards, timeout=45):
+    from bqueryd_tpu.rpc import RPC
+
+    rpc = RPC(coordination_url=url, timeout=timeout,
+              loglevel=logging.WARNING)
+    df = rpc.groupby(list(shards), ["g"], [["v", "sum", "s"]], [])
+    got = dict(zip(df["g"].tolist(), df["s"].tolist()))
+    return rpc, got
+
+
+def test_rpc_capacity_e2e(capacity_cluster):
+    controller = capacity_cluster["controller"]
+    rpc, got = _ask(
+        capacity_cluster["url"], capacity_cluster["shards"]
+    )
+    assert got == capacity_cluster["expected"]
+    for _ in range(3):
+        rpc.groupby(
+            capacity_cluster["shards"], ["g"], [["v", "sum", "s"]], []
+        )
+    worker_id = capacity_cluster["worker"].worker_id
+    # the WRM-fed μ needs heartbeats carrying all 4 completions' totals
+    wait_until(
+        lambda: controller.capacity.evaluate().get("workers", {})
+        .get(worker_id, {}).get("samples", 0) >= 4,
+        desc="capacity model absorbed every completion",
+    )
+    snap = rpc.capacity()
+    assert snap["enabled"] is True
+    fleet = snap["fleet"]
+    assert fleet["workers"] == 1
+    assert fleet["coverage"] == 1.0
+    assert fleet["arrival_qps"] > 0
+    assert fleet["knee_qps"] is not None and fleet["knee_qps"] > 0
+    assert fleet["state"] in ("ok", "warm", "saturated", "overloaded")
+    w = snap["workers"][worker_id]
+    assert w["mu"] > 0 and w["samples"] >= 4
+    # the pipeline busy clocks rode the WRM: a bottleneck stage is named
+    assert w["bottleneck_stage"] is not None
+    # both shards appear on the heat map via the batched group dispatch
+    heat_shards = {h["shard"] for h in snap["shard_heat"]}
+    assert set(capacity_cluster["shards"]) <= heat_shards
+    # measured admission/dispatch waits flowed from finished autopsies
+    assert fleet["measured_wait_samples"] > 0
+
+
+def test_worker_restart_mid_burst_rebases_model(capacity_cluster):
+    """The satellite regression: a worker process restarting under the
+    same node id resets its cumulative WRM counters; the capacity model
+    must rebase (resets counter), μ must stay finite/positive, and the
+    health window must rebuild instead of reporting zero throughput."""
+    from bqueryd_tpu.worker import WorkerNode
+
+    controller = capacity_cluster["controller"]
+    worker = capacity_cluster["worker"]
+    worker_id = worker.worker_id
+    rpc, got = _ask(capacity_cluster["url"], capacity_cluster["shards"])
+    assert got == capacity_cluster["expected"]
+    for _ in range(3):
+        rpc.groupby(
+            capacity_cluster["shards"], ["g"], [["v", "sum", "s"]], []
+        )
+    wait_until(
+        lambda: controller.capacity.evaluate().get("workers", {})
+        .get(worker_id, {}).get("samples", 0) >= 4,
+        desc="pre-restart μ measured",
+    )
+    # crash the worker (no StopMessage — a graceful stop would deregister
+    # it and drop the model's baseline, which is NOT the restart scenario)
+    # and restart a fresh process-equivalent under the SAME node id
+    # (fresh registries: cumulative totals restart at 0)
+    worker.controllers.clear()
+    worker.running = False
+    wait_until(lambda: worker.socket.closed, desc="old worker stopped")
+    worker2 = WorkerNode(
+        coordination_url=capacity_cluster["url"],
+        data_dir=str(capacity_cluster["tmp_path"]),
+        loglevel=logging.WARNING, restart_check=False,
+        heartbeat_interval=0.1, poll_timeout=0.05,
+    )
+    worker2.worker_id = worker_id
+    worker2.socket.identity = worker_id.encode()
+    threads2 = _start(worker2)
+    try:
+        wait_until(
+            lambda: controller.worker_map.get(worker_id, {}).get(
+                "uptime", 1e9
+            ) < 30,
+            desc="restarted worker re-registered under the same id",
+        )
+        # mid-burst continues against the restarted worker
+        rpc2, got2 = _ask(
+            capacity_cluster["url"], capacity_cluster["shards"]
+        )
+        assert got2 == capacity_cluster["expected"]
+        for _ in range(2):
+            rpc2.groupby(
+                capacity_cluster["shards"], ["g"], [["v", "sum", "s"]], []
+            )
+        wait_until(
+            lambda: controller.capacity.worker_resets() >= 1,
+            desc="capacity model detected the counter reset",
+        )
+        result = controller.capacity.evaluate()
+        w = result["workers"][worker_id]
+        assert w["mu"] is not None and w["mu"] > 0
+        assert w["resets"] >= 1
+        # the health scorer rebased too: the window reports the restarted
+        # process's own (positive) throughput, not a clamped zero
+        wait_until(
+            lambda: controller.health.statuses().get(worker_id, {}).get(
+                "queries", 0
+            ) > 0,
+            desc="health window rebuilt after restart",
+        )
+    finally:
+        _stop([worker2], threads2)
+
+
+def test_capacity_disabled_serves_stub(capacity_cluster, monkeypatch):
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY", "0")
+    rpc, _ = _ask(capacity_cluster["url"], capacity_cluster["shards"])
+    snap = rpc.capacity()
+    assert snap["enabled"] is False

@@ -1997,3 +1997,170 @@ def test_bundle_shared_scan_fails_over_as_one_unit(
     finally:
         chaos.disarm()
         _stop([controller] + workers, threads)
+
+
+def _warm_capacity_model(controller, workers, mem_store_url, shards,
+                         expected, max_queries=20):
+    """Query until every worker has a measured μ in the capacity model
+    (random dispatch placement reaches both holders within a few tries)."""
+    import time as _time
+
+    def measured():
+        ws = controller.capacity.evaluate().get("workers", {})
+        return all(
+            ws.get(w.worker_id, {}).get("mu") is not None for w in workers
+        )
+
+    deadline = _time.time() + 30
+    for _ in range(max_queries):
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected
+        if measured():
+            return
+        if _time.time() > deadline:
+            break
+        _time.sleep(0.2)  # let a WRM carry the bumped totals
+    wait_until(measured, desc="every worker measured by the capacity model")
+
+
+def test_capacity_kill_worker_shrinks_fleet_mu_and_advises_scale_up(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """PR-8 kill-worker chaos under the PR-12 capacity model: the dead
+    worker's μ leaves the fleet aggregate, no query fails (replica
+    failover), and with load still arriving the shadow advisor flips to
+    scale_up.  Thresholds are pinned low so the micro-queries' utilization
+    registers — the test targets the mechanism, not the default knobs."""
+    from bqueryd_tpu import chaos
+
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_HYSTERESIS_S", "0")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_WINDOW_S", "20")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_SATURATED", "0.005")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_WARM", "0.002")
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        _warm_capacity_model(
+            controller, workers, mem_store_url, shards, expected
+        )
+        before = controller.capacity.evaluate()["fleet"]
+        assert before["measured_workers"] == 2
+        chaos.arm({
+            "seed": 5,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected  # failover: ZERO failed queries
+        chaos.disarm()
+        wait_until(
+            lambda: len(controller.worker_map) == 1,
+            desc="dead worker culled",
+        )
+        # keep load arriving so the advisor has evidence post-kill
+        for _ in range(3):
+            _, again = _ask_sum(mem_store_url, shards)
+            assert again == expected
+        result = controller.capacity.evaluate()
+        fleet = result["fleet"]
+        assert fleet["workers"] == 1
+        assert fleet["measured_workers"] == 1
+        # the dead worker's μ left the aggregate: the model dropped it
+        # entirely, and fleet capacity is now the survivor's μ alone (the
+        # raw sum comparison would race the survivor's own EWMA drifting
+        # as warm micro-queries speed up)
+        dead = [w for w in workers if w.worker_id not in
+                controller.worker_map]
+        assert len(dead) == 1
+        assert dead[0].worker_id not in result["workers"]
+        survivor_mu = [
+            w["mu"] for wid, w in result["workers"].items()
+        ]
+        assert len(survivor_mu) == 1 and survivor_mu[0] is not None
+        assert fleet["mu_dispatches_per_s"] == pytest.approx(
+            survivor_mu[0], rel=0.01
+        )
+        actions = [r["action"] for r in result["recommendations"]]
+        assert "scale_up" in actions, result["recommendations"]
+        assert controller.counters["capacity_scale_up_advised"] >= 1
+        assert controller.counters["failover_dispatches"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
+def test_capacity_wedge_device_shrinks_fleet_mu_and_advises_scale_up(
+    tmp_path, mem_store_url, monkeypatch
+):
+    """Wedge-device chaos: the wedged worker stays registered (transient
+    failover serves its queries from the replica holder) but its
+    advertised latch excludes its μ from fleet capacity — fleet μ shrinks,
+    queries keep succeeding, and the advisor flips to scale_up."""
+    from bqueryd_tpu import chaos
+
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_HYSTERESIS_S", "0")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_WINDOW_S", "20")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_SATURATED", "0.005")
+    monkeypatch.setenv("BQUERYD_TPU_CAPACITY_RHO_WARM", "0.002")
+    controller, workers, threads, expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    try:
+        _warm_capacity_model(
+            controller, workers, mem_store_url, shards, expected
+        )
+        before = controller.capacity.evaluate()["fleet"]
+        assert before["measured_workers"] == 2
+        chaos.arm({
+            "seed": 6,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "wedge",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        _, got = _ask_sum(mem_store_url, shards)
+        assert got == expected  # transient failover: ZERO failed queries
+        chaos.disarm()
+        wedged = [w for w in workers if w._chaos_wedged]
+        assert len(wedged) == 1
+        # the latch must ride a WRM into the capacity model
+        wait_until(
+            lambda: controller.capacity.evaluate()
+            .get("workers", {})
+            .get(wedged[0].worker_id, {})
+            .get("wedged") is True,
+            desc="wedge latch absorbed by the capacity model",
+        )
+        for _ in range(3):
+            _, again = _ask_sum(mem_store_url, shards)
+            assert again == expected
+        result = controller.capacity.evaluate()
+        fleet = result["fleet"]
+        # both workers still registered — but the wedged one is no longer
+        # counted as capacity: fleet μ is the healthy worker's alone (the
+        # raw before/after sum comparison would race the healthy worker's
+        # own EWMA drift on warm micro-queries)
+        assert len(controller.worker_map) == 2
+        assert fleet["workers"] == 2
+        assert fleet["measured_workers"] == 1
+        healthy_mu = [
+            w["mu"] for w in result["workers"].values()
+            if not w["wedged"] and w["mu"] is not None
+        ]
+        assert len(healthy_mu) == 1
+        assert fleet["mu_dispatches_per_s"] == pytest.approx(
+            healthy_mu[0], rel=0.01
+        )
+        actions = [r["action"] for r in result["recommendations"]]
+        assert "scale_up" in actions, result["recommendations"]
+        assert controller.counters["transient_faults"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)

@@ -635,6 +635,15 @@ def test_timeline_ring_e2e(slo_cluster):
     assert newest["groupby_p99_s"] is not None
     assert "default" in newest["slo"]
     assert entries[0]["ts"] <= newest["ts"]
+    # PR 12: the ring doubles as capacity history — every entry carries
+    # the fleet utilization/saturation slice
+    capacity = newest["capacity"]
+    for key in (
+        "utilization", "state", "arrival_qps", "knee_qps",
+        "headroom_qps", "model_drift",
+    ):
+        assert key in capacity
+    assert capacity["state"] in ("ok", "warm", "saturated", "overloaded")
 
 
 def test_debug_bundle_carries_new_sections(slo_cluster):
@@ -647,7 +656,7 @@ def test_debug_bundle_carries_new_sections(slo_cluster):
     rpc.groupby(slo_cluster["shards"], ["g"], [["v", "sum", "s"]], [])
     trace_id = rpc.last_trace_id  # every rpc call re-mints last_trace_id
     bundle = rpc.debug_bundle(trace_id)
-    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/2"
+    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/3"
     controller_section = bundle["controller"]
     # the autopsy of the bundled trace rides inline
     assert controller_section["autopsy"]["trace_id"] == trace_id
@@ -658,6 +667,13 @@ def test_debug_bundle_carries_new_sections(slo_cluster):
     assert "samples_total" in controller_section["calibration"]
     assert controller_section["chaos"]["armed"] is False
     assert "injected_total" in controller_section["chaos"]
+    # PR 12: the fleet capacity model rides the bundle, freshly evaluated
+    capacity = controller_section["capacity"]
+    assert capacity["enabled"] is True
+    assert capacity["fleet"]["state"] in (
+        "ok", "warm", "saturated", "overloaded"
+    )
+    assert "recommendations" in capacity
     assert "shards_by_holders" in controller_section["replication"]
     assert controller_section["batch_window"]["window_ms"] == 0
     assert "default" in controller_section["slo"]
