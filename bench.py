@@ -100,7 +100,10 @@ def build_dataset():
     """Write the sharded taxi-like dataset once; reuse across runs."""
     from bqueryd_tpu.storage.ctable import ctable
 
-    stamp = os.path.join(DATA_DIR, f"ready_v2_{ROWS}_{SHARDS}")
+    # v3: adds pickup_ts (datetime64[ns]) for the operators section's
+    # window rollups; untouched configs never decode it, so their walls
+    # are unaffected
+    stamp = os.path.join(DATA_DIR, f"ready_v3_{ROWS}_{SHARDS}")
     names = [f"taxi_{i}.bcolzs" for i in range(SHARDS)]
     if not os.path.exists(stamp):
         import shutil
@@ -134,6 +137,14 @@ def build_dataset():
                     "trip_distance": (rng.random(rows) * 30).astype(
                         np.float32
                     ),
+                    # one synthetic day of pickups at second granularity
+                    # (datetime64[ns]): the operators section's window
+                    # rollup axis
+                    "pickup_ts": (
+                        np.int64(1_700_000_000_000_000_000)
+                        + rng.randint(0, 86_400, rows).astype(np.int64)
+                        * np.int64(1_000_000_000)
+                    ).view("datetime64[ns]"),
                 }
             )
             ctable.fromdataframe(df, os.path.join(DATA_DIR, name))
@@ -1101,6 +1112,188 @@ def ensure_backend():
         xb._backend_factories.pop("axon", None)
     except Exception:
         pass
+
+
+def run_operators_section(names, rpc):
+    """Operator-DAG executor (plan.dag / parallel.opexec): per-operator
+    sharded walls on the live cluster via ``rpc.query``, with correctness
+    gates — broadcast-join and top-k parity vs pandas (ints bit-exact),
+    sketch max quantile error <= the documented alpha bound, window-rollup
+    parity, and the plain-DAG bit-identity probe (a plain shape through
+    ``rpc.query`` vs ``rpc.groupby``)."""
+    import pandas as pd
+
+    from bqueryd_tpu.storage.ctable import ctable
+
+    alpha = 0.01
+    detail = {"alpha": alpha, "operators": {}}
+    cols = [
+        "passenger_count", "fare_amount", "PULocationID",
+        "trip_distance", "pickup_ts",
+    ]
+    frames = []
+    for name in names:
+        t = ctable(os.path.join(DATA_DIR, name), mode="r")
+        frames.append(
+            pd.DataFrame({c: np.asarray(t.column(c)) for c in cols})
+        )
+    full = pd.concat(frames, ignore_index=True)
+
+    dim = {
+        "PULocationID": np.arange(1, 266, dtype=np.int64),
+        "zone": np.array(
+            [f"z{i % 5}" for i in range(1, 266)], dtype=object
+        ),
+    }
+
+    def timed(spec):
+        rpc.query(spec)  # warmup: compile + decode/align caches
+        walls = []
+        df = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            df = rpc.query(spec)
+            walls.append(time.perf_counter() - t0)
+        return min(walls), df
+
+    # -- broadcast hash join ------------------------------------------------
+    wall, got = timed({
+        "table": list(names), "groupby": ["zone"],
+        "aggs": [["fare_amount", "sum", "fare"],
+                 ["fare_amount", "count", "n"]],
+        "join": {"table": dim, "on": "PULocationID", "select": ["zone"]},
+    })
+    expj = full.merge(
+        pd.DataFrame(dim), on="PULocationID"
+    ).groupby("zone")["fare_amount"].agg(["sum", "count"])
+    join_ok = (
+        dict(zip(got["zone"], got["fare"])) == expj["sum"].to_dict()
+        and dict(zip(got["zone"], got["n"])) == expj["count"].to_dict()
+    )
+    detail["operators"]["join_broadcast"] = {
+        "wall_s": round(wall, 4),
+        "groups": len(got),
+        "dim_rows": len(dim["PULocationID"]),
+        "parity_vs_pandas": bool(join_ok),
+    }
+
+    # -- per-group top-k ------------------------------------------------------
+    wall, got = timed({
+        "table": list(names), "groupby": ["passenger_count"],
+        "aggs": [["fare_amount", "topk", "top5", {"k": 5}]],
+    })
+    expk = full.groupby("passenger_count")["fare_amount"].apply(
+        lambda s: np.sort(s.to_numpy())[::-1][:5]
+    )
+    topk_ok = all(
+        np.array_equal(np.asarray(got["top5"][i]), expk.loc[g])
+        for i, g in enumerate(got["passenger_count"])
+    )
+    detail["operators"]["topk"] = {
+        "wall_s": round(wall, 4),
+        "k": 5,
+        "groups": len(got),
+        "parity_vs_pandas": bool(topk_ok),
+    }
+
+    # -- mergeable quantile sketches ----------------------------------------
+    wall, got = timed({
+        "table": list(names), "groupby": ["passenger_count"],
+        "aggs": [
+            ["trip_distance", "quantile", "p50",
+             {"q": 0.5, "alpha": alpha}],
+            ["trip_distance", "quantile", "p99",
+             {"q": 0.99, "alpha": alpha}],
+        ],
+    })
+    max_err = 0.0
+    for q, col in ((0.5, "p50"), (0.99, "p99")):
+        expq = full.groupby("passenger_count")["trip_distance"].quantile(
+            q, interpolation="lower"
+        )
+        for i, g in enumerate(got["passenger_count"]):
+            e = float(expq.loc[g])
+            rel = abs(float(got[col][i]) - e) / max(abs(e), 1e-9)
+            max_err = max(max_err, rel)
+    detail["operators"]["quantile_sketch"] = {
+        "wall_s": round(wall, 4),
+        "quantiles": [0.5, 0.99],
+        "groups": len(got),
+        "max_rel_err": round(max_err, 6),
+        "documented_bound": alpha,
+        "within_bound": bool(max_err <= alpha + 1e-9),
+    }
+
+    # -- time-window rollup ---------------------------------------------------
+    wall, got = timed({
+        "table": list(names),
+        "groupby": [{"window": {"on": "pickup_ts", "every": "1h",
+                                "alias": "hour"}}],
+        "aggs": [["fare_amount", "sum", "fare"]],
+    })
+    exph = full.groupby(
+        full["pickup_ts"].dt.floor("1h")
+    )["fare_amount"].sum()
+    window_ok = (
+        dict(zip(pd.to_datetime(got["hour"]), got["fare"]))
+        == exph.to_dict()
+    )
+    detail["operators"]["window_rollup"] = {
+        "wall_s": round(wall, 4),
+        "every": "1h",
+        "windows": len(got),
+        "parity_vs_pandas": bool(window_ok),
+    }
+
+    # -- plain-DAG bit-identity probe -----------------------------------------
+    # the same plain shape through rpc.query (compiles via plan.dag on the
+    # worker) and rpc.groupby (classic path): values must be bit-equal —
+    # the fuzz corpus proves this per kernel, this probe proves it e2e
+    plain_spec = {
+        "table": list(names), "groupby": ["passenger_count"],
+        "aggs": [["fare_amount", "sum", "fare_amount"]],
+    }
+    _w, via_query = timed(plain_spec)
+    via_groupby = rpc.groupby(
+        list(names), ["passenger_count"],
+        [["fare_amount", "sum", "fare_amount"]], [],
+    )
+    a = via_query.sort_values("passenger_count").reset_index(drop=True)
+    b = via_groupby.sort_values("passenger_count").reset_index(drop=True)
+    plain_identical = (
+        a["passenger_count"].tolist() == b["passenger_count"].tolist()
+        and a["fare_amount"].tolist() == b["fare_amount"].tolist()
+    )
+    detail["plain_dag_bit_identical"] = bool(plain_identical)
+    detail["note"] = (
+        "walls are sharded end-to-end rpc.query rounds on the live "
+        "cluster (min of 2, warm); parity gates: join/topk/window ints "
+        "bit-exact vs pandas, sketch max relative quantile error <= "
+        "alpha vs pandas interpolation='lower', and a plain groupby "
+        "shape bit-identical through the DAG path"
+    )
+    print(
+        f"[bench] operators: join {detail['operators']['join_broadcast']['wall_s']}s "
+        f"(parity {join_ok}), topk "
+        f"{detail['operators']['topk']['wall_s']}s (parity {topk_ok}), "
+        f"quantile {detail['operators']['quantile_sketch']['wall_s']}s "
+        f"(max_rel_err {max_err:.5f} <= {alpha}), window "
+        f"{detail['operators']['window_rollup']['wall_s']}s "
+        f"(parity {window_ok}), plain-DAG identical {plain_identical}",
+        file=sys.stderr, flush=True,
+    )
+    if os.environ.get("BENCH_OPERATORS_GATE", "1") == "1":
+        assert join_ok, "operators gate: broadcast-join parity vs pandas"
+        assert topk_ok, "operators gate: top-k parity vs pandas"
+        assert detail["operators"]["quantile_sketch"]["within_bound"], (
+            f"operators gate: sketch max quantile error {max_err} above "
+            f"the documented bound {alpha}"
+        )
+        assert window_ok, "operators gate: window-rollup parity vs pandas"
+        assert plain_identical, (
+            "operators gate: plain groupby through the DAG path diverged"
+        )
+    return detail
 
 
 def main():
@@ -2659,6 +2852,31 @@ def main():
                     flush=True,
                 )
 
+        # operators: the operator-DAG executor's per-operator sharded
+        # walls + correctness gates (join/topk/window parity vs pandas,
+        # sketch error <= the documented alpha, plain-DAG bit-identity)
+        operators_detail = {}
+        if (
+            os.environ.get("BENCH_OPERATORS", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            try:
+                operators_detail = run_operators_section(names, rpc)
+            except AssertionError:
+                raise  # the operators gate is deterministic: fail the bench
+            except Exception as exc:
+                if os.environ.get("BENCH_OPERATORS_GATE", "1") == "1":
+                    # same contract as the chaos/slo/capacity gates: a
+                    # setup crash must fail the armed gate, not record
+                    # operators={} and read as green
+                    raise
+                print(
+                    f"[bench] operators section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         # chaos: the zero-failed-query degradation gate — scripted
         # kill-worker / drop-reply / wedge-device / redis-partition
         # scenarios over fresh 2-replica clusters of the same dataset,
@@ -2822,6 +3040,10 @@ def main():
             # on vs off, per-query parity, amortization counters, and the
             # PR-1 identical-dedup probe
             "concurrency": concurrency_detail,
+            # operator-DAG executor: per-operator sharded walls, pandas
+            # parity (ints bit-exact), sketch quantile error <= alpha,
+            # and the plain-DAG bit-identity probe
+            "operators": operators_detail,
             # fault-injection scenarios: zero-failed-query gate, result
             # parity vs the fault-free run, failover/hedge counters
             "chaos": chaos_detail,

@@ -171,6 +171,18 @@ ENV_REGISTRY = {
            "round program shapes onto a coarse grid (0=exact shapes)"),
         _v("DISTINCT_VALUES_LIMIT", "int", "5_000_000",
            "cap on shipped (group, value) pairs per count_distinct payload"),
+        _v("TOPK_LIMIT", "int", "1024",
+           "per-group k ceiling for DAG top-k operators (payload grows "
+           "with k x groups x shards)",
+           related=("JOIN_BROADCAST_LIMIT", "SKETCH_ALPHA")),
+        _v("JOIN_BROADCAST_LIMIT", "int", "100_000",
+           "max dimension-table rows a broadcast hash join ships per "
+           "dispatch envelope (larger tables belong in shards)",
+           related=("TOPK_LIMIT", "SKETCH_ALPHA")),
+        _v("SKETCH_ALPHA", "float", "0.01",
+           "default relative accuracy of DAG quantile sketches "
+           "(DDSketch-style log buckets; estimate error <= alpha)",
+           related=("TOPK_LIMIT", "JOIN_BROADCAST_LIMIT")),
         _v("DOWNLOAD_THREADS", "int", "3",
            "parallel blob fetches per downloader"),
         _v("INCOMING", "path", "data_dir/incoming",

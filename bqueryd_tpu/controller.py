@@ -22,6 +22,7 @@ port in 14300-14399, reference bqueryd/controller.py:33-42):
 * 2 frames                    = worker/peer control message
 """
 
+import base64
 import binascii
 import os
 import pickle
@@ -72,7 +73,7 @@ _env_num = env_num
 
 CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
-    "download", "readfile", "execute_code", "sleep", "groupby",
+    "download", "readfile", "execute_code", "sleep", "groupby", "query",
     "trace", "metrics", "slow_queries", "health", "debug_bundle",
     "autopsy", "timeline", "capacity",
 )
@@ -1837,8 +1838,22 @@ class ControllerNode:
         self._drop_work(token)
         parents = list(subscribers) if subscribers else [parent]
         if msg.isa(ErrorMessage):
+            error_class = None
+            error_text = msg.get("payload")
+            if msg.get("dag") and "unknown aggregation op" in str(error_text):
+                # a DAG dispatch answered by a pre-DAG worker, which
+                # executed the positional params and rejected the extended
+                # op string: reply the STRUCTURED mixed-version error
+                # MIGRATION "PR 13" promises instead of relaying the
+                # worker traceback
+                error_class = "UnsupportedOp"
+                error_text = (
+                    "query dispatched to a worker that does not understand "
+                    "operator DAGs (pre-PR-13 build); upgrade every calc "
+                    "worker before using rpc.query (see MIGRATION.md PR 13)"
+                )
             for p in parents:
-                self.abort_parent(p, msg.get("payload"))
+                self.abort_parent(p, error_text, error_class=error_class)
             return
         if msg.get("_bundle_parents"):
             if msg.get("bundle_members") is not None:
@@ -2901,6 +2916,39 @@ class ControllerNode:
                 "groupby needs (filenames, groupby_cols, agg_list, where_terms)"
             )
         filenames, groupby_cols, agg_list, where_terms = args
+        # an op outside the groupby surface fails HERE, as a structured
+        # envelope (error_class="UnsupportedOp", like PR-8's
+        # DispatchExhausted) — not as a worker traceback relayed three
+        # hops later.  The richer operators live behind rpc.query().
+        from bqueryd_tpu.models.query import AGG_OPS, normalize_agg_list
+
+        try:
+            bad = sorted(
+                {
+                    str(a[1]) for a in normalize_agg_list(agg_list)
+                    if a[1] not in AGG_OPS
+                }
+            )
+        except Exception:
+            bad = []  # malformed agg lists fall through to plan compile
+        if bad:
+            self.reply_rpc_raw(
+                msg["token"],
+                pickle.dumps(
+                    {
+                        "ok": False,
+                        "error_class": "UnsupportedOp",
+                        "error": (
+                            f"unsupported aggregation op(s) {bad}; groupby "
+                            f"supports {list(AGG_OPS)} — joins, top-k, "
+                            f"quantiles and window rollups go through the "
+                            f"query verb (rpc.query)"
+                        ),
+                    },
+                    protocol=4,
+                ),
+            )
+            return
         # tracing: adopt the client's TraceContext (mint one for traceless
         # clients); the controller "groupby" span parents every query span
         # and is itself a child of the client's root span
@@ -2926,6 +2974,65 @@ class ControllerNode:
                     parent_span_id=obs_state["qspan_id"], node=self.address,
                 )
             )
+        self._admit_plan(msg, plan, kwargs)
+
+    def rpc_query(self, msg):
+        """The operator-DAG verb: compiles the ``rpc.query(spec)`` dict
+        into a typed :class:`~bqueryd_tpu.plan.dag.OperatorDAG` (broadcast
+        hash joins, per-group top-k, mergeable quantile sketches,
+        time-window rollups), derives its groupby-shaped logical plan, and
+        admits it through the SAME machinery as ``rpc_groupby`` — so
+        admission quotas, shard pruning, replica failover, SLO accounting
+        and autopsy attribution all apply to the new operators for free.
+        Spec validation failures reply a structured envelope
+        (``error_class`` "UnsupportedOp" / "InvalidPlan")."""
+        from bqueryd_tpu import obs
+        from bqueryd_tpu.plan import dag as dagmod
+
+        args, kwargs = msg.get_args_kwargs()
+        if len(args) != 1 or not isinstance(args[0], dict):
+            raise ValueError("query needs one spec dict argument")
+        ctx = obs.TraceContext.from_wire(msg.get_trace())
+        if ctx is None:
+            ctx = obs.TraceContext.new_root()
+        obs_state = self._new_obs_state(ctx)
+        msg["_obs"] = obs_state
+        plan_start = time.time()
+        plan_clock = time.perf_counter()
+        try:
+            dag = dagmod.compile_query(args[0])
+            plan, dag_kwargs = dagmod.groupby_equivalent(dag)
+        except dagmod.DagValidationError as exc:
+            self.reply_rpc_raw(
+                msg["token"],
+                pickle.dumps(
+                    {
+                        "ok": False,
+                        "error_class": exc.error_class,
+                        "error": str(exc),
+                    },
+                    protocol=4,
+                ),
+            )
+            return
+        kwargs = dict(kwargs)
+        kwargs.update(dag_kwargs)
+        if obs.enabled():
+            obs_state["spans"].append(
+                obs.make_span(
+                    ctx.trace_id, "plan", plan_start,
+                    time.perf_counter() - plan_clock,
+                    parent_span_id=obs_state["qspan_id"], node=self.address,
+                )
+            )
+        self._admit_plan(msg, plan, kwargs)
+
+    def _admit_plan(self, msg, plan, kwargs):
+        """Shared admission tail of the groupby-shaped verbs (groupby and
+        query): unknown-shard check, quota/dedup/supersede handling, BUSY
+        backpressure, and the micro-batch staging launch."""
+        from bqueryd_tpu import plan as planmod
+
         unknown = [f for f in plan.filenames if f not in self.files_map]
         if unknown:
             raise ValueError(f"filenames not found on any worker: {unknown}")
@@ -3399,6 +3506,21 @@ class ControllerNode:
 
         affinity = kwargs.get("affinity")
         planner_on = planmod.planner_enabled()
+        # operator-DAG dispatch (rpc.query): the wire DAG rides every
+        # CalcMessage under the `dag` binary key; calibrated strategy
+        # hints are skipped — the DAG executor routes its own kernels, so
+        # issuing hints here would inflate the planner-hint counters with
+        # hints that structurally cannot run (same reasoning as bundles)
+        dag_wire = kwargs.get("dag")
+        dag_blob = None
+        if dag_wire is not None:
+            planner_on = False
+            # encode ONCE: the wire DAG carries the whole broadcast
+            # dimension table, and re-pickling it per shard group would
+            # put O(groups x table_bytes) on the dispatch hot path
+            dag_blob = base64.b64encode(
+                pickle.dumps(dag_wire, protocol=messages.PICKLE_PROTOCOL)
+            ).decode("ascii")
         groupby_cols = list(plan.groupby.keys)
         agg_list = plan.physical_agg_list()
         where_terms = plan.where_terms
@@ -3489,6 +3611,13 @@ class ControllerNode:
                     plan, group, strategy=strategy, sole=sole
                 ),
             )
+            if dag_blob is not None:
+                # capable workers execute the DAG; pre-DAG workers fall
+                # back to the positional params, whose extended op strings
+                # they reject — process_worker_result rewrites that
+                # rejection into the structured mixed-version error
+                # (MIGRATION "PR 13")
+                shard["dag"] = dag_blob
             self._register_work(shard, [parent_token], work_key=work_key)
             self.worker_out_messages.setdefault(affinity, []).append(shard)
 

@@ -96,6 +96,18 @@ ENVELOPE_SCHEMA = {
               "(member_id, aggs, filters, deadline) — the worker executes "
               "the whole compatible micro-batch as one scan "
               "(plan.bundle.bundle_fragment)",
+    "dag": "base64-pickled operator-DAG wire form (plan.dag.OperatorDAG."
+           "to_wire): the rpc.query verb's compiled program — broadcast "
+           "join dimension table, window rollup, post-derivation filter, "
+           "and the ordered physical agg list with extended op strings "
+           "(topk:<k>:..., quantile:<q>:<alpha>).  Authoritative on "
+           "capable workers; pre-DAG workers fall back to the positional "
+           "params and reject the extended ops, which the controller "
+           "rewrites into the structured UnsupportedOp mixed-version "
+           "error (MIGRATION 'PR 13').  Extended partials ride the "
+           "ordinary data frame as ResultPayload part kinds "
+           "topk_values/topk_offsets and sketch_keys/sketch_counts/"
+           "sketch_offsets (parallel.opexec)",
     "worker_id": "explicit dispatch target / WRM sender identity",
     "ticket": "download/movebcolz ticket id",
     # worker -> controller replies
@@ -270,6 +282,10 @@ SPAN_SCHEMA = {
     "prune": "raw phase: chunk-level predicate pruning",
     "filter": "raw phase 'mask': where-term mask evaluation",
     "factorize": "raw phase: key factorization (engine path)",
+    "join_probe": "raw phase 'join': broadcast hash-join key factorize + "
+                  "dimension probe gather (operator-DAG executor)",
+    "window_rollup": "raw phase 'rollup': datetime-bucket derived group "
+                     "key computation (operator-DAG executor)",
     "align": "raw phase: cross-shard key alignment / global key space",
     "h2d_transfer": "raw phase 'layout': host->device uploads",
     "kernel": "raw phase 'aggregate': the compiled mesh program (collective "
@@ -282,6 +298,8 @@ SPAN_SCHEMA = {
     # raw PhaseTimer names (surface via obs.trace.PHASE_SPAN_NAMES)
     "open": "raw name of storage_decode",
     "mask": "raw name of filter",
+    "join": "raw name of join_probe",
+    "rollup": "raw name of window_rollup",
     "layout": "raw name of h2d_transfer",
     "aggregate": "raw name of kernel",
     "fetch": "raw name of d2h_fetch",

@@ -93,6 +93,13 @@ def freeze_value(value):
     import hashlib
 
     if isinstance(value, np.ndarray):
+        if value.dtype == object:
+            # tobytes() of an object array is its POINTER bytes: unstable
+            # across (de)serializations and aliasable under allocator
+            # reuse — freeze the contained VALUES instead (string
+            # dimension-table columns, plan.dag join signatures)
+            return ("ndarray-obj", value.shape,
+                    tuple(freeze_value(v) for v in value.ravel().tolist()))
         return ("ndarray", value.dtype.str, value.shape,
                 hashlib.sha1(value.tobytes()).hexdigest())
     if isinstance(value, (list, tuple)):

@@ -68,6 +68,8 @@ SPAN_CATEGORIES = {
     "filter": "filter",
     "factorize": "align",               # key factorization is alignment work
     "align": "align",
+    "join_probe": "join_probe",         # DAG broadcast-join probe gather
+    "window_rollup": "window_rollup",   # DAG datetime-bucket key derivation
     "h2d_transfer": "h2d_transfer",
     "kernel": "kernel",
     "d2h_fetch": "d2h_fetch",
@@ -96,6 +98,8 @@ SEGMENT_PRIORITY = (
     "collective_merge",
     "h2d_transfer",
     "filter",
+    "join_probe",
+    "window_rollup",
     "align",
     "storage_decode",
     "reply_serialization",

@@ -56,6 +56,8 @@ TRACE_KEY = "trace"
 PHASE_SPAN_NAMES = {
     "open": "storage_decode",
     "mask": "filter",
+    "join": "join_probe",
+    "rollup": "window_rollup",
     "layout": "h2d_transfer",
     "aggregate": "kernel",
     "fetch": "d2h_fetch",

@@ -1,0 +1,175 @@
+"""Device kernels for the relational operators (plan.dag / parallel.opexec).
+
+Three jitted entry points, each the device twin of a NumPy host kernel in
+:mod:`bqueryd_tpu.parallel.opexec` (the host kernels are the reference
+semantics, exactly like ``host_partial_tables`` is for the groupby
+kernels) and each behind the SAME guards as every other kernel: the
+executor routes here only above ``models.query.host_kernel_rows`` and
+never on a wedged backend.
+
+* :func:`gather_positions` — the broadcast hash-join probe: one gather of
+  per-distinct-key dimension positions onto rows (the join's per-row work
+  is exactly a gather once the join key is factorized).
+* :func:`topk_partials` — per-group top-k via the sort route: one
+  ``lexsort`` by (group, value-desc), within-group rank from a
+  ``searchsorted`` against the sorted codes, rank-< k scatter into a
+  dense ``[groups, k]`` buffer (group dimension bucketed through
+  ``program_bucket`` for program reuse), compacted host-side into the
+  flat mergeable form.
+* :func:`sketch_bin` — the quantile sketch's elementwise bucket-key
+  computation (the only per-row work a sketch does); the per-(group,
+  bucket) pairing stays host-side in ``opexec.sketch_flat``.
+
+All three are compile-profiled (PR-3 ``profile.instrument``) so their
+programs land in the per-shape registry and jit-cache accounting like
+every other kernel.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bqueryd_tpu.obs import profile as _obsprofile
+from bqueryd_tpu.parallel.opexec import (
+    SKETCH_MIN_MAGNITUDE,
+    sketch_layout,
+)
+from bqueryd_tpu.models.query import _segment_local_arange
+from bqueryd_tpu.ops.groupby import program_bucket
+
+
+@jax.jit
+def _gather_positions(pos_of_unique, codes):
+    safe = jnp.maximum(codes, 0)
+    return jnp.where(
+        codes >= 0, pos_of_unique[safe], jnp.int64(-1)
+    )
+
+
+_gather_positions = _obsprofile.instrument(
+    "ops.relops_gather", _gather_positions
+)
+
+
+def gather_positions(pos_of_unique, codes):
+    """Join probe on device: ``row_pos[i] = pos_of_unique[codes[i]]`` with
+    null codes mapped to -1 (miss)."""
+    return np.asarray(
+        jax.device_get(
+            _gather_positions(
+                jnp.asarray(pos_of_unique, dtype=jnp.int64),
+                jnp.asarray(codes),
+            )
+        )
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "largest", "n_groups", "drop_nan", "sentinel", "float_neg"
+    ),
+)
+def _topk_dense(codes, values, mask, k, largest, n_groups, drop_nan,
+                sentinel, float_neg):
+    """Dense per-group top-k: ``(values[n_groups, k], counts[n_groups])``
+    with group g's best-first values in row g's first ``counts[g]`` slots.
+    Sort route: one lexsort, ranks via searchsorted, rank-bounded scatter.
+    ``float_neg`` is the STATIC dtype decision (computed by the wrapper):
+    the monotone-decreasing sort key is negation for floats (NaNs already
+    excluded) and bitwise-not for ints/bools (~x = -x-1, wrap-free)."""
+    valid = codes >= 0
+    if mask is not None:
+        valid = valid & mask
+    if sentinel is not None:
+        valid = valid & (values != sentinel)
+    if drop_nan:
+        valid = valid & ~jnp.isnan(values)
+    if largest:
+        sort_v = -values if float_neg else ~values
+    else:
+        sort_v = values
+    key = jnp.where(valid, codes.astype(jnp.int64), n_groups)
+    order = jnp.lexsort((sort_v, key))
+    sk = key[order]
+    sv = values[order]
+    first = jnp.searchsorted(sk, sk, side="left")
+    rank = jnp.arange(sk.shape[0], dtype=jnp.int64) - first
+    sel = (sk < n_groups) & (rank < k)
+    gidx = jnp.where(sel, sk, n_groups)      # out-of-range -> mode="drop"
+    ridx = jnp.where(sel, rank, 0)
+    out = jnp.zeros((n_groups, k), dtype=values.dtype)
+    out = out.at[gidx, ridx].set(sv, mode="drop")
+    counts = jnp.zeros(n_groups, dtype=jnp.int64).at[gidx].add(
+        jnp.where(sel, 1, 0), mode="drop"
+    )
+    return out, counts
+
+
+_topk_dense = _obsprofile.instrument("ops.relops_topk", _topk_dense)
+
+
+def topk_partials(codes, values, k, largest, n_groups, mask=None,
+                  sentinel=None):
+    """Per-shard top-k partial on device, compacted to the flat mergeable
+    form ``(values, offsets)`` — bit-identical to
+    ``opexec.topk_flat`` (the host twin)."""
+    values = np.asarray(values)
+    n_prog = program_bucket(n_groups)
+    dense, cnt = jax.device_get(
+        _topk_dense(
+            jnp.asarray(np.asarray(codes), dtype=jnp.int64),
+            jnp.asarray(values),
+            None if mask is None else jnp.asarray(mask, dtype=bool),
+            k=int(k),
+            largest=bool(largest),
+            n_groups=int(n_prog),
+            drop_nan=bool(np.issubdtype(values.dtype, np.floating)),
+            sentinel=None if sentinel is None else int(sentinel),
+            float_neg=bool(np.issubdtype(values.dtype, np.floating)),
+        )
+    )
+    dense = np.asarray(dense)[:n_groups]
+    take = np.asarray(cnt, dtype=np.int64)[:n_groups]
+    rep = np.repeat(np.arange(n_groups, dtype=np.int64), take)
+    loc = _segment_local_arange(take)
+    flat = dense[rep, loc] if len(rep) else dense[:0, 0]
+    offsets = np.zeros(n_groups + 1, dtype=np.int64)
+    np.cumsum(take, out=offsets[1:])
+    return flat, offsets
+
+
+@functools.partial(
+    jax.jit, static_argnames=("log_gamma", "imin", "imax")
+)
+def _sketch_bin(values, log_gamma, imin, imax):
+    v = values.astype(jnp.float64)
+    mag = jnp.abs(v)
+    tiny = mag < SKETCH_MIN_MAGNITUDE
+    i = jnp.ceil(jnp.log(jnp.where(tiny, 1.0, mag)) / log_gamma)
+    i = jnp.clip(i, imin, imax).astype(jnp.int64)
+    unsigned = i - jnp.int64(imin) + 1
+    return jnp.where(
+        tiny, jnp.int64(0), jnp.where(v < 0, -unsigned, unsigned)
+    )
+
+
+_sketch_bin = _obsprofile.instrument("ops.relops_sketch_bin", _sketch_bin)
+
+
+def sketch_bin(values, alpha):
+    """Elementwise signed bucket key per row (device twin of
+    ``opexec.sketch_keys_host``).  NaN rows produce garbage keys and MUST
+    be excluded by the caller's validity mask (``opexec.sketch_flat``
+    does), same contract as the host kernel."""
+    _gamma, lg, imin, imax = sketch_layout(alpha)
+    return np.asarray(
+        jax.device_get(
+            _sketch_bin(
+                jnp.asarray(np.asarray(values)),
+                log_gamma=float(lg), imin=int(imin), imax=int(imax),
+            )
+        )
+    )

@@ -233,6 +233,45 @@ def _merge_partials(payloads):
     for ai in range(len(ops)):
         part_names = first["aggs"][ai].keys()
         merged = {}
+        if "topk_offsets" in part_names:
+            # per-group top-k lists merge by k-way RE-SELECT over the
+            # concatenation (plan.dag TopK nodes; parallel.opexec owns the
+            # selection so shard partials and this merge stay associative)
+            from bqueryd_tpu.parallel import opexec
+            from bqueryd_tpu.plan.dag import parse_op
+
+            _kind, k, largest = parse_op(ops[ai])
+            values, offsets = opexec.merge_topk_parts(
+                [
+                    (g, p["aggs"][ai]["topk_values"],
+                     p["aggs"][ai]["topk_offsets"])
+                    for g, p in zip(group_of, payloads)
+                ],
+                k, largest, n_global,
+            )
+            merged["topk_values"] = values
+            merged["topk_offsets"] = offsets
+            aggs.append(merged)
+            continue
+        if "sketch_offsets" in part_names:
+            # quantile sketches merge by bucket-count ADDITION — the
+            # mergeable-histogram property (plan.dag QuantileSketch)
+            from bqueryd_tpu.parallel import opexec
+
+            keys, counts, offsets = opexec.merge_sketch_parts(
+                [
+                    (g, p["aggs"][ai]["sketch_keys"],
+                     p["aggs"][ai]["sketch_counts"],
+                     p["aggs"][ai]["sketch_offsets"])
+                    for g, p in zip(group_of, payloads)
+                ],
+                n_global,
+            )
+            merged["sketch_keys"] = keys
+            merged["sketch_counts"] = counts
+            merged["sketch_offsets"] = offsets
+            aggs.append(merged)
+            continue
         if "distinct_offsets" in part_names:
             flat_parts = [
                 (g, p["aggs"][ai]["distinct_values"],
@@ -360,6 +399,15 @@ def finalize_table(merged):
                 values = np.diff(np.asarray(agg["distinct_offsets"]))
         elif op == "sorted_count_distinct":
             values = agg["distinct"]
+        elif isinstance(op, str) and op.startswith("topk:"):
+            # object array of per-group best-first value arrays
+            from bqueryd_tpu.parallel import opexec
+
+            values = opexec.finalize_topk(agg, vkind=vkind)
+        elif isinstance(op, str) and op.startswith("quantile:"):
+            from bqueryd_tpu.parallel import opexec
+
+            values = opexec.finalize_quantile(agg, op)
         elif op in ("min", "max"):
             values = agg[op]
             empty = agg["count"] == 0

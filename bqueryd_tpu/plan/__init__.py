@@ -20,6 +20,10 @@ Four pieces, all control-plane safe (no JAX, no pandas):
   admission micro-batch window (``BQUERYD_TPU_BATCH_WINDOW_MS``), the plan
   compatibility signature, and the bundle fragments whole compatible groups
   dispatch (and demultiplex) as one unit.
+* :mod:`bqueryd_tpu.plan.dag`       — the typed operator DAG behind
+  ``rpc.query``: broadcast hash joins, per-group top-k, mergeable quantile
+  sketches, time-window rollups — compiled from query specs (and from
+  plain groupbys, which round-trip bit-identically onto the engine path).
 
 ``BQUERYD_TPU_PLANNER=0`` disables plan-time pruning and strategy hints
 (queries revert to the static fan-out); admission limits are controlled by
@@ -60,6 +64,7 @@ from bqueryd_tpu.plan.strategy import (  # noqa: F401
 )
 from bqueryd_tpu.plan import bundle  # noqa: F401
 from bqueryd_tpu.plan import calibrate  # noqa: F401
+from bqueryd_tpu.plan import dag  # noqa: F401
 
 
 def planner_enabled():

@@ -110,13 +110,18 @@ class LogicalPlan:
     def signature(self):
         """Hashable identity of the plan MINUS the shard set: two queries with
         equal signatures over the same shard group compute identical payloads
-        (the shared-dispatch fusion key in the controller)."""
+        (the shared-dispatch fusion key in the controller).  A DAG query
+        (``plan.dag``) folds the full operator-DAG signature in — its join
+        table / window / post-derivation filter are invisible to the
+        groupby-shaped fields, and without this a DAG query could dedup-fuse
+        with a plain groupby over the same projection."""
         return (
             tuple(self.groupby.keys),
             freeze_value(self.physical_agg_list()),
             freeze_value(self.where_terms),
             bool(self.aggregate_rows),
             self.expand_filter_column,
+            getattr(self, "dag_sig", None),
         )
 
     def explain(self):

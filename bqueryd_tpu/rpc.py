@@ -267,7 +267,11 @@ class RPC:
         )
 
     def _parse_reply(self, name, reply):
-        if name == "groupby":
+        if name in ("groupby", "query"):
+            # both groupby-shaped verbs reply the same pickled result
+            # envelope (per-shard payloads + timings); the payload ops are
+            # self-describing, so extended operators (topk/quantile)
+            # finalize through the same merge path
             return self._parse_groupby_reply(reply)
         msg = msg_factory(reply)
         if isinstance(msg, ErrorMessage):
@@ -353,6 +357,29 @@ class RPC:
         if key_cols is None:
             return stacked
         return stacked.groupby(key_cols, sort=True).sum().reset_index()
+
+    # -- operator-DAG queries ----------------------------------------------
+    def query(self, spec, deadline=None, priority=None):
+        """The operator-DAG verb: richer shapes than ``groupby`` — broadcast
+        hash joins of small dimension tables, per-group top-k, approximate
+        quantiles (mergeable sketches), and time-window rollups — compiled
+        controller-side into a typed operator DAG
+        (:mod:`bqueryd_tpu.plan.dag`; spec shape documented there and in
+        the README's "Relational operators" section).  Returns a pandas
+        DataFrame like ``groupby``: top-k columns hold per-group
+        best-first value arrays, quantile columns hold the sketch
+        estimates (error bound <= the op's alpha).  The spec is validated
+        client-side first so malformed queries fail without a round trip;
+        the controller re-validates authoritatively."""
+        from bqueryd_tpu.plan import dag as dagmod
+
+        dagmod.compile_query(spec)
+        kwargs = {}
+        if deadline is not None:
+            kwargs["deadline"] = deadline
+        if priority is not None:
+            kwargs["priority"] = priority
+        return self._rpc("query", (spec,), kwargs)
 
     # -- query autopsy -----------------------------------------------------
     def autopsy(self, trace_id=None):

@@ -1265,6 +1265,21 @@ class WorkerNode(WorkerBase):
 
         return ResultPayload(merged)
 
+    def _execute_dag(self, tables, dag, timer):
+        """Extended operator-DAG execution (joins / top-k / quantile
+        sketches / window rollups): per-shard operator pipelines scheduled
+        on the PR-4 stage pool, host value-keyed merge — the same merge
+        (and failover/autopsy surface) non-psum-mergeable aggregations
+        always used.  Plain DAGs never reach here (handle_work routes them
+        through ``_execute`` bit-identically)."""
+        from bqueryd_tpu.parallel.opexec import DagExecutor
+
+        executor = DagExecutor(self.engine)
+        payload = executor.execute(tables, dag, timer=timer)
+        self._last_effective_strategy = executor.last_effective_strategy
+        self._last_merge_mode = executor.last_merge_mode
+        return payload
+
     def _open_table(self, rootdir):
         """Table instances cached by meta identity: re-opening per query
         costs a meta.json parse per shard; activation (fresh inode/mtime)
@@ -1311,39 +1326,64 @@ class WorkerNode(WorkerBase):
         timer = PhaseTimer(recorder=recorder, span_names=obs.PHASE_SPAN_NAMES)
         args, kwargs = msg.get_args_kwargs()
         filename, groupby_cols, agg_list, where_terms = args[:4]
-        # a planning controller ships the compiled plan fragment alongside
-        # the reference-shaped params: the fragment is authoritative (it
-        # carries the rewritten query + the kernel-strategy hint); bare
-        # params keep working for mixed-version clusters and direct tests
-        fragment = (
-            msg.get_from_binary("plan") if msg.get("plan") else None
-        )
-        strategy = None
-        if fragment:
-            from bqueryd_tpu.plan import calibrate, fragment_to_query
+        from bqueryd_tpu.plan import dag as dagmod
 
-            query = fragment_to_query(fragment)
-            strategy = fragment.get("strategy")
-            if strategy in (None, "auto"):
-                strategy = None
-            elif strategy == "matmul" and fragment.get("strategy_binding"):
-                # calibration-backed promotion rides the wire as advisory
-                # "matmul" + this flag (old workers ignore it — see
-                # plan.logical.fragment_for); reconstruct the binding form
-                # unless BQUERYD_TPU_CALIB=0, the kill switch that restores
-                # pre-calibration behaviour exactly on this worker even
-                # when a calibrating controller emitted the promotion
-                if calibrate.enabled():
-                    strategy = "matmul!"
+        # EVERY groupby now compiles through the operator-DAG layer
+        # (plan.dag).  A `dag` envelope key is the authoritative program
+        # (the rpc.query verb's richer shapes: joins, top-k, sketches,
+        # windows); otherwise the classic fragment/params build a plain
+        # DAG, whose plain_groupby_query() round trip is field-exact — the
+        # engine path below executes it bit-identically to the pre-DAG
+        # sequence (proven over the fuzz corpus).
+        dag = None
+        if msg.get("dag"):
+            dag = dagmod.OperatorDAG.from_wire(msg.get_from_binary("dag"))
+            dag.sole_payload = bool(msg.get("sole_shard"))
+            query = dag.plain_groupby_query()
+            strategy = None
         else:
-            query = GroupByQuery(
-                groupby_cols,
-                agg_list,
-                where_terms or [],
-                aggregate=kwargs.get("aggregate", True),
-                expand_filter_column=kwargs.get("expand_filter_column"),
-                sole_payload=bool(msg.get("sole_shard")),
+            # a planning controller ships the compiled plan fragment
+            # alongside the reference-shaped params: the fragment is
+            # authoritative (it carries the rewritten query + the
+            # kernel-strategy hint); bare params keep working for
+            # mixed-version clusters and direct tests
+            fragment = (
+                msg.get_from_binary("plan") if msg.get("plan") else None
             )
+            strategy = None
+            if fragment:
+                from bqueryd_tpu.plan import calibrate, fragment_to_query
+
+                query = fragment_to_query(fragment)
+                strategy = fragment.get("strategy")
+                if strategy in (None, "auto"):
+                    strategy = None
+                elif strategy == "matmul" and fragment.get(
+                    "strategy_binding"
+                ):
+                    # calibration-backed promotion rides the wire as
+                    # advisory "matmul" + this flag (old workers ignore it
+                    # — see plan.logical.fragment_for); reconstruct the
+                    # binding form unless BQUERYD_TPU_CALIB=0, the kill
+                    # switch that restores pre-calibration behaviour
+                    # exactly on this worker even when a calibrating
+                    # controller emitted the promotion
+                    if calibrate.enabled():
+                        strategy = "matmul!"
+            else:
+                query = GroupByQuery(
+                    groupby_cols,
+                    agg_list,
+                    where_terms or [],
+                    aggregate=kwargs.get("aggregate", True),
+                    expand_filter_column=kwargs.get("expand_filter_column"),
+                    sole_payload=bool(msg.get("sole_shard")),
+                )
+            # round-trip through the DAG layer: compile, then rebuild the
+            # query from the compiled form — the pair is field-exact, so
+            # execution (and the result-cache key) stays bit-identical
+            dag = dagmod.dag_from_query(query)
+            query = dag.plain_groupby_query()
         filenames = filename if isinstance(filename, list) else [filename]
         tables = []
         with timer.phase("open"):
@@ -1359,7 +1399,13 @@ class WorkerNode(WorkerBase):
             from bqueryd_tpu.parallel.executor import _table_key
 
             cache_key = (
-                tuple(_table_key(t) for t in tables), query.signature()
+                tuple(_table_key(t) for t in tables),
+                # extended DAGs have no GroupByQuery form; their identity
+                # is the DAG signature (join table / window / sketch
+                # params included).  Plain shapes keep the historical
+                # query-signature key, so warm caches survive the DAG
+                # refactor untouched.
+                query.signature() if query is not None else dag.signature(),
             )
             data = cache.get(cache_key)
             if data is not None:
@@ -1384,9 +1430,14 @@ class WorkerNode(WorkerBase):
                 profiling = contextlib.nullcontext()
             mem_before = obs_profile.profiler().memory_sample()
             with profiling:
-                payload = self._execute(
-                    tables, query, timer, strategy=strategy
-                )
+                if query is not None:
+                    # plain shape: the unchanged engine/mesh path —
+                    # bit-identical to the pre-DAG hardwired sequence
+                    payload = self._execute(
+                        tables, query, timer, strategy=strategy
+                    )
+                else:
+                    payload = self._execute_dag(tables, dag, timer)
             effective = getattr(self, "_last_effective_strategy", None)
             merge_mode = getattr(self, "_last_merge_mode", None)
             if recorder is not None and effective:
@@ -1439,6 +1490,11 @@ class WorkerNode(WorkerBase):
         ):
             self._shed_caches()
         reply = msg.copy()
+        # the reply must not echo the request's DAG (the broadcast join
+        # ships the whole dimension table under that key — re-shipping it
+        # worker->controller per shard reply is pure wire waste; the
+        # controller only consults the key on ERROR replies, which keep it)
+        reply.pop("dag", None)
         reply["data"] = data
         reply["phase_timings"] = timer.as_dict()
         if recorder is not None:

@@ -1042,6 +1042,68 @@ def test_die_after_ack_fails_over_to_replica_holder(tmp_path, mem_store_url):
         _stop([controller] + workers, threads)
 
 
+def test_dag_topk_quantile_fails_over_under_kill_worker(
+    tmp_path, mem_store_url
+):
+    """PR-13 acceptance: an operator-DAG query (top-k + quantile sketch)
+    survives the PR-8 kill-worker chaos plan with ZERO failed queries —
+    the DAG rides the same dispatch/failover machinery as plain groupbys,
+    so the shard re-queues onto the replica holder and the merged answer
+    matches the fault-free run exactly."""
+    import numpy as np
+
+    from bqueryd_tpu import chaos
+    from bqueryd_tpu.rpc import RPC
+
+    controller, workers, threads, _expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    spec = {
+        "table": list(shards),
+        "groupby": ["g"],
+        "aggs": [
+            ["v", "sum", "s"],
+            ["v", "topk", "t3", {"k": 3}],
+            ["v", "quantile", "p50", {"q": 0.5, "alpha": 0.01}],
+        ],
+    }
+    try:
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=45,
+            loglevel=logging.WARNING,
+        )
+        baseline = rpc.query(spec)  # fault-free reference run
+        chaos.arm({
+            "seed": 7,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        got = rpc.query(spec)
+        assert chaos.injected_total() >= 1
+        assert controller.counters["failover_dispatches"] >= 1
+        # zero failed queries: the chaos run answered, and EXACTLY —
+        # int sums bit-equal, top-k lists identical, sketch estimates
+        # bit-equal (same buckets, same counts, whoever served the shard)
+        assert got["g"].tolist() == baseline["g"].tolist()
+        assert got["s"].tolist() == baseline["s"].tolist()
+        for a, b in zip(got["t3"], baseline["t3"]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            got["p50"].to_numpy(), baseline["p50"].to_numpy()
+        )
+        wait_until(
+            lambda: len(controller.worker_map) == 1,
+            desc="dead worker culled",
+        )
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
 def test_transient_device_fault_retries_on_other_holder(
     tmp_path, mem_store_url
 ):
