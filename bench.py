@@ -410,59 +410,15 @@ def _chaos_cluster(n_workers=2):
     """Fresh controller + N replica calc workers over the bench dataset
     (every worker holds every shard — the topology failover needs), with
     failover-scaled timeouts.  One cluster per scenario: a killed or
-    wedged worker must not leak into the next scenario's measurement."""
-    from bqueryd_tpu.controller import ControllerNode
-    from bqueryd_tpu.rpc import RPC
-    from bqueryd_tpu.worker import WorkerNode
-
-    url = f"mem://chaos-{os.urandom(4).hex()}"
-    controller = ControllerNode(
-        coordination_url=url,
-        loglevel=logging.WARNING,
-        runfile_dir=DATA_DIR,
-        heartbeat_interval=0.1,
+    wedged worker must not leak into the next scenario's measurement.
+    Bootstrap/teardown shared with the ingest section (_ingest_cluster)."""
+    return _ingest_cluster(
+        DATA_DIR, "chaos", SHARDS, n_workers=n_workers,
+        rpc_timeout=60,
         dead_worker_timeout=2.0,
         dispatch_timeout=2.0,
         dispatch_hard_timeout=4.0,
     )
-    workers = [
-        WorkerNode(
-            coordination_url=url,
-            data_dir=DATA_DIR,
-            loglevel=logging.WARNING,
-            restart_check=False,
-            heartbeat_interval=0.25,
-            poll_timeout=0.05,
-        )
-        for _ in range(n_workers)
-    ]
-    nodes = [controller] + workers
-    threads = [
-        threading.Thread(target=node.go, daemon=True) for node in nodes
-    ]
-    for t in threads:
-        t.start()
-    deadline = time.time() + 120
-    while time.time() < deadline:
-        # list(): the controller thread mutates files_map during worker
-        # registration while this poll iterates it
-        if len(controller.files_map) >= SHARDS and all(
-            len(holders) >= n_workers
-            for holders in list(controller.files_map.values())
-        ):
-            break
-        time.sleep(0.05)
-    else:
-        # stop the half-started cluster before raising: the caller never
-        # sees these nodes, and orphaned daemon threads would keep
-        # heartbeating under every later bench section
-        for node in nodes:
-            node.running = False
-        for t in threads:
-            t.join(timeout=5)
-        raise RuntimeError("chaos cluster never reached replica topology")
-    rpc = RPC(coordination_url=url, timeout=60, loglevel=logging.WARNING)
-    return rpc, controller, workers, nodes, threads
 
 
 def _chaos_burst(rpc, names, repeats=3):
@@ -1293,6 +1249,394 @@ def run_operators_section(names, rpc):
         assert plain_identical, (
             "operators gate: plain groupby through the DAG path diverged"
         )
+    return detail
+
+
+def _ingest_cluster(data_dir, coord_tag, n_shards, n_workers=1,
+                    worker_dirs=None, rpc_timeout=120, **controller_kw):
+    """Fresh controller + N calc workers over a section-owned dataset: the
+    shared bootstrap of the chaos scenarios (replica topology over the
+    bench dataset) and the ingest section (its own directory — appends
+    must never mutate the shared bench data).  Waits until every shard is
+    advertised by every worker; a bring-up timeout stops the half-started
+    nodes before raising (orphaned daemon threads would keep heartbeating
+    under every later section)."""
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.worker import WorkerNode
+
+    url = f"mem://{coord_tag}-{os.urandom(4).hex()}"
+    controller = ControllerNode(
+        coordination_url=url,
+        loglevel=logging.WARNING,
+        runfile_dir=data_dir,
+        heartbeat_interval=0.1,
+        **controller_kw,
+    )
+    dirs = worker_dirs or [data_dir] * n_workers
+    workers = [
+        WorkerNode(
+            coordination_url=url,
+            data_dir=d,
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.25,
+            poll_timeout=0.05,
+        )
+        for d in dirs
+    ]
+    nodes = [controller] + workers
+    threads = [
+        threading.Thread(target=node.go, daemon=True) for node in nodes
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        # list(): the controller thread mutates files_map during worker
+        # registration while this poll iterates it
+        if len(controller.files_map) >= n_shards and all(
+            len(h) >= n_workers for h in list(controller.files_map.values())
+        ):
+            break
+        time.sleep(0.05)
+    else:
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+        raise RuntimeError(
+            f"{coord_tag} cluster never reached its replica topology"
+        )
+    rpc = RPC(
+        coordination_url=url, timeout=rpc_timeout, loglevel=logging.WARNING
+    )
+    return rpc, controller, workers, nodes, threads
+
+
+def _ingest_frame(rng, rows, seq_offset):
+    import pandas as pd
+
+    return pd.DataFrame(
+        {
+            "g": rng.randint(0, 7, rows).astype(np.int64),
+            "v": rng.randint(-10000, 10000, rows).astype(np.int64),
+            "f": rng.random(rows).astype(np.float32),
+            # per-shard-monotonic: the zone-map pruning axis (real streams
+            # are approximately time-ordered, which is exactly what makes
+            # chunk min/max discriminating)
+            "seq": np.arange(
+                seq_offset, seq_offset + rows, dtype=np.int64
+            ),
+        }
+    )
+
+
+def _ingest_frames_match(a, b, int_cols, float_cols):
+    """(ints_bitexact, floats_bitexact, float_max_rel_err)"""
+    ints = all(
+        np.array_equal(a[c].to_numpy(), b[c].to_numpy()) for c in int_cols
+    ) and np.array_equal(a["g"].to_numpy(), b["g"].to_numpy())
+    fbit = all(
+        np.array_equal(a[c].to_numpy(), b[c].to_numpy())
+        for c in float_cols
+    )
+    max_rel = 0.0
+    for c in float_cols:
+        x = a[c].to_numpy(dtype=np.float64)
+        y = b[c].to_numpy(dtype=np.float64)
+        with np.errstate(all="ignore"):
+            rel = (
+                np.nanmax(np.abs(x - y) / np.maximum(np.abs(y), 1e-30))
+                if len(x) else 0.0
+            )
+        max_rel = max(max_rel, float(rel))
+    return ints, fbit, max_rel
+
+
+def run_ingest_section():
+    """Streaming ingest (PR 14): the three acceptance gates.
+
+    (a) **delta-maintained repeat**: after a <=10% append, the repeat query
+        is served by aggregating only the appended chunks and merging the
+        delta partial — gated >= 3x faster than the cold full recompute of
+        the same post-append data, ints bit-exact / floats within
+        reassociation ulps vs that recompute;
+    (b) **chunk-granular zone-map pruning**: a filter matching ~8% of the
+        per-shard-monotonic ``seq`` axis decodes <= 25% of chunks
+        (worker chunk counters), results bit-identical to the
+        ``BQUERYD_TPU_CHUNK_PRUNE=0`` path;
+    (c) **append-while-querying under chaos**: a 2-replica cluster absorbs
+        appends + queries across a die_after_ack worker kill with ZERO
+        failed queries and int-bit-exact results vs the expected frame.
+
+    Runs over its own dataset/clusters (appends must not mutate the shared
+    bench dataset); gates assert unless BENCH_INGEST_GATE=0.
+    """
+    import shutil
+
+    import pandas as pd
+
+    gate_on = os.environ.get("BENCH_INGEST_GATE", "1") == "1"
+    detail = {}
+    rows_ingest = min(ROWS, 2_000_000)
+    n_shards = 4
+    per = rows_ingest // n_shards
+    chunklen = max(4096, per // 24)
+    base_dir = os.path.join(DATA_DIR, "ingest")
+    shutil.rmtree(base_dir, ignore_errors=True)
+    os.makedirs(base_dir, exist_ok=True)
+    from bqueryd_tpu.storage.ctable import ctable
+
+    rng = np.random.RandomState(23)
+    names = [f"ing_{i}.bcolzs" for i in range(n_shards)]
+    frames = {}
+    for name in names:
+        df = _ingest_frame(rng, per, 0)
+        frames[name] = df
+        ctable.fromdataframe(
+            df, os.path.join(base_dir, name), chunklen=chunklen
+        )
+    detail["rows"] = rows_ingest
+    detail["shards"] = n_shards
+    detail["chunklen"] = chunklen
+
+    q = (
+        list(names), ["g"],
+        [["v", "sum", "vs"], ["f", "mean", "fm"], ["v", "min", "vmin"]],
+        [],
+    )
+
+    def run_query(rpc, query):
+        t0 = time.perf_counter()
+        df = rpc.groupby(*query)
+        return time.perf_counter() - t0, df.sort_values("g").reset_index(
+            drop=True
+        )
+
+    rpc, controller, workers, nodes, threads = _ingest_cluster(
+        base_dir, "ingest", n_shards
+    )
+    try:
+        worker = workers[0]
+        # -- (a) delta-maintained repeat vs cold recompute ----------------
+        run_query(rpc, q)  # establishes the delta base
+        # two append+refresh cycles: the FIRST delta refresh may compile
+        # the tail's program shape (a one-time cost, exactly like the main
+        # configs' warmup); the SECOND cycle is the steady-state serving
+        # wall the gate measures — still a real refresh over fresh rows
+        # (each cycle's append grows the tables again).  Total appended
+        # stays <= 10% of the base.
+        append_rows = max(per // 24, 1)  # ~4% per shard per cycle
+        append_wall = 0.0
+        delta_walls = []
+        delta_refreshes = 0
+        seq_base = per
+        for _cycle in range(2):
+            t_append = time.perf_counter()
+            for name in names:
+                extra = _ingest_frame(rng, append_rows, seq_base)
+                frames[name] = pd.concat(
+                    [frames[name], extra], ignore_index=True
+                )
+                rpc.append(name, extra)
+            seq_base += append_rows
+            append_wall += time.perf_counter() - t_append
+            refreshes_before = worker.delta_refreshes_total.value
+            wall, delta_df = run_query(rpc, q)
+            delta_walls.append(wall)
+            delta_refreshes += int(
+                worker.delta_refreshes_total.value - refreshes_before
+            )
+        delta_wall = delta_walls[-1]
+        routes = set(
+            (rpc.last_call_strategies or {}).get("effective", {}).values()
+        )
+        # cold full recompute of the SAME post-append data
+        _clear_worker_caches(worker)
+        cold_wall, cold_df = run_query(rpc, q)
+        ints_ok, _fbit, max_rel = _ingest_frames_match(
+            delta_df, cold_df, ["vs", "vmin"], ["fm"]
+        )
+        speedup = cold_wall / max(delta_wall, 1e-9)
+        detail["delta"] = {
+            "append_rows_per_shard": 2 * append_rows,
+            "append_fraction": round(2 * append_rows / per, 4),
+            "append_wall_s": round(append_wall, 4),
+            "delta_walls_s": [round(w, 4) for w in delta_walls],
+            "delta_wall_s": round(delta_wall, 4),
+            "cold_wall_s": round(cold_wall, 4),
+            "speedup": round(speedup, 2),
+            "delta_refreshes": delta_refreshes,
+            "routes": sorted(routes),
+            "ints_bitexact": bool(ints_ok),
+            "float_max_rel_err": max_rel,
+        }
+        print(
+            f"[bench] ingest delta: cold {cold_wall:.3f}s vs delta "
+            f"{delta_wall:.3f}s ({speedup:.1f}x), refreshes "
+            f"{delta_refreshes}, ints_bitexact {ints_ok}",
+            flush=True,
+        )
+
+        # -- (b) chunk-granular zone-map pruning --------------------------
+        total_seq = per + 2 * append_rows
+        threshold = int(total_seq * 0.92)  # ~8% of every shard matches
+        qf = (
+            list(names), ["g"],
+            [["v", "sum", "vs"], ["f", "mean", "fm"]],
+            [["seq", ">", threshold]],
+        )
+        dec0 = worker.chunks_decoded_total.value
+        skip0 = worker.chunks_skipped_total.value
+        pruned_wall, pruned_df = run_query(rpc, qf)
+        decoded = worker.chunks_decoded_total.value - dec0
+        skipped = worker.chunks_skipped_total.value - skip0
+        decode_fraction = decoded / max(decoded + skipped, 1)
+        os.environ["BQUERYD_TPU_CHUNK_PRUNE"] = "0"
+        try:
+            _clear_worker_caches(worker)
+            unpruned_wall, unpruned_df = run_query(rpc, qf)
+        finally:
+            os.environ.pop("BQUERYD_TPU_CHUNK_PRUNE", None)
+        p_ints, p_fbit, p_rel = _ingest_frames_match(
+            pruned_df, unpruned_df, ["vs"], ["fm"]
+        )
+        full_frame = pd.concat(frames.values(), ignore_index=True)
+        match_fraction = float(
+            (full_frame["seq"] > threshold).mean()
+        )
+        detail["prune"] = {
+            "filter_match_fraction": round(match_fraction, 4),
+            "chunks_decoded": int(decoded),
+            "chunks_skipped": int(skipped),
+            "decode_fraction": round(decode_fraction, 4),
+            "pruned_wall_s": round(pruned_wall, 4),
+            "unpruned_wall_s": round(unpruned_wall, 4),
+            "ints_bitexact": bool(p_ints),
+            "floats_bitexact": bool(p_fbit),
+            "float_max_rel_err": p_rel,
+        }
+        print(
+            f"[bench] ingest prune: decoded {decoded}/{decoded + skipped} "
+            f"chunks ({decode_fraction:.2%}) for a "
+            f"{match_fraction:.2%}-selective filter; bitexact "
+            f"ints={p_ints} floats={p_fbit}",
+            flush=True,
+        )
+    finally:
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+        try:
+            rpc._close_socket()
+        except Exception:
+            pass
+
+    # -- (c) append-while-querying under the chaos harness ----------------
+    from bqueryd_tpu import chaos as chaos_mod
+
+    rows_chaos = max(per // 2, 5000)
+    rep_dirs = [os.path.join(base_dir, "rep_a"), os.path.join(base_dir, "rep_b")]
+    for d in rep_dirs:
+        os.makedirs(d, exist_ok=True)
+    rng_c = np.random.RandomState(29)
+    chaos_frame = _ingest_frame(rng_c, rows_chaos, 0)
+    ctable.fromdataframe(
+        chaos_frame, os.path.join(rep_dirs[0], "rep.bcolzs"),
+        chunklen=chunklen,
+    )
+    shutil.copytree(
+        os.path.join(rep_dirs[0], "rep.bcolzs"),
+        os.path.join(rep_dirs[1], "rep.bcolzs"),
+    )
+    rpc, controller, workers, nodes, threads = _ingest_cluster(
+        rep_dirs[0], "ingest-chaos", 1, n_workers=2,
+        worker_dirs=rep_dirs,
+        dead_worker_timeout=2.0, dispatch_timeout=2.0,
+        dispatch_hard_timeout=8.0,
+    )
+    qc = (["rep.bcolzs"], ["g"], [["v", "sum", "vs"]], [])
+    failed = 0
+    parity_ok = True
+    try:
+        expected = chaos_frame.groupby("g")["v"].sum().to_dict()
+
+        def check(df):
+            return dict(zip(df["g"].tolist(), df["vs"].tolist())) == expected
+
+        _w, df0 = run_query(rpc, qc)
+        parity_ok = parity_ok and check(df0)
+        extra = _ingest_frame(rng_c, rows_chaos // 10, rows_chaos)
+        rpc.append("rep.bcolzs", extra)
+        chaos_frame = pd.concat([chaos_frame, extra], ignore_index=True)
+        expected = chaos_frame.groupby("g")["v"].sum().to_dict()
+        chaos_mod.arm({
+            "seed": 3,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        injected0 = chaos_mod.injected_total()
+        for _ in range(3):
+            try:
+                _w, dfc = run_query(rpc, qc)
+            except Exception as exc:
+                failed += 1
+                print(
+                    f"[bench] ingest chaos query FAILED: {exc!r}",
+                    file=sys.stderr, flush=True,
+                )
+                continue
+            parity_ok = parity_ok and check(dfc)
+        chaos_mod.disarm()
+        detail["chaos"] = {
+            "failed_queries": failed,
+            "parity_ok": bool(parity_ok),
+            "fault_injected": chaos_mod.injected_total() - injected0,
+            "failover_dispatches": int(
+                controller.counters["failover_dispatches"]
+            ),
+        }
+        print(
+            f"[bench] ingest chaos: {failed} failed queries, parity "
+            f"{parity_ok}, failovers "
+            f"{detail['chaos']['failover_dispatches']}",
+            flush=True,
+        )
+    finally:
+        chaos_mod.disarm()
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+        try:
+            rpc._close_socket()
+        except Exception:
+            pass
+
+    gates = {
+        "delta_speedup_ge_3x": detail["delta"]["speedup"] >= 3.0,
+        "delta_ints_bitexact": detail["delta"]["ints_bitexact"],
+        "delta_float_ulps": detail["delta"]["float_max_rel_err"] < 1e-9,
+        "delta_refreshed": detail["delta"]["delta_refreshes"] >= 1,
+        "prune_decode_le_25pct": detail["prune"]["decode_fraction"] <= 0.25,
+        "prune_bitexact": (
+            detail["prune"]["ints_bitexact"]
+            and detail["prune"]["floats_bitexact"]
+        ),
+        "chaos_zero_failed": detail["chaos"]["failed_queries"] == 0,
+        "chaos_parity": detail["chaos"]["parity_ok"],
+        "chaos_failover_ran": detail["chaos"]["failover_dispatches"] >= 1,
+    }
+    detail["gates"] = gates
+    if gate_on:
+        bad = sorted(k for k, ok in gates.items() if not ok)
+        assert not bad, f"ingest gates failed: {bad} — {detail}"
     return detail
 
 
@@ -2877,6 +3221,32 @@ def main():
                     flush=True,
                 )
 
+        # ingest: streaming append + delta maintenance + chunk pruning —
+        # the PR-14 acceptance gates (delta >= 3x cold with parity, filter
+        # decode <= 25% of chunks bit-identical, append-while-querying
+        # chaos zero-failed) over the section's OWN dataset/clusters
+        ingest_detail = {}
+        if (
+            os.environ.get("BENCH_INGEST", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            try:
+                ingest_detail = run_ingest_section()
+            except AssertionError:
+                raise  # the ingest gate is deterministic: fail the bench
+            except Exception as exc:
+                if os.environ.get("BENCH_INGEST_GATE", "1") == "1":
+                    # same contract as the chaos/slo/capacity gates: a
+                    # setup crash must fail the armed gate, not record
+                    # ingest={} and read as green
+                    raise
+                print(
+                    f"[bench] ingest section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         # chaos: the zero-failed-query degradation gate — scripted
         # kill-worker / drop-reply / wedge-device / redis-partition
         # scenarios over fresh 2-replica clusters of the same dataset,
@@ -3044,6 +3414,10 @@ def main():
             # parity (ints bit-exact), sketch quantile error <= alpha,
             # and the plain-DAG bit-identity probe
             "operators": operators_detail,
+            # streaming ingest: delta-refresh speedup vs cold recompute,
+            # zone-map chunk-decode fraction + bit-identity, and the
+            # append-while-querying chaos parity gate
+            "ingest": ingest_detail,
             # fault-injection scenarios: zero-failed-query gate, result
             # parity vs the fault-free run, failover/hedge counters
             "chaos": chaos_detail,
@@ -3141,6 +3515,18 @@ def main():
                         ).get("shared_dispatches"),
                         "conc_parity": concurrency_detail.get(
                             "parity_identical"
+                        ),
+                        "ingest_delta_speedup": (
+                            ingest_detail.get("delta") or {}
+                        ).get("speedup"),
+                        "ingest_decode_fraction": (
+                            ingest_detail.get("prune") or {}
+                        ).get("decode_fraction"),
+                        "ingest_chaos_zero_failed": (
+                            (ingest_detail.get("chaos") or {}).get(
+                                "failed_queries"
+                            ) == 0
+                            if ingest_detail.get("chaos") else None
                         ),
                         "chaos_zero_failed": chaos_detail.get(
                             "zero_failed_queries"

@@ -324,6 +324,29 @@ ENV_REGISTRY = {
            "this many nodes per file (0 = every node, the historical "
            "full fan-out); under-replicated shards surface in "
            "rpc.info()['replication'] (failover needs >=2)"),
+        _v("APPEND", "flag", "1",
+           "accept rpc.append on this worker (0 = reject streaming "
+           "ingest with a structured error)",
+           related=("DELTA_SERVE", "CHUNK_PRUNE")),
+        _v("CHUNK_PRUNE", "flag", "1",
+           "chunk-granular zone-map pruning: filtered queries decode only "
+           "chunks whose per-chunk min/max can match (0 = whole-column "
+           "decode, the pre-PR-14 path)",
+           related=("CHUNK_PRUNE_SELECTIVITY", "APPEND")),
+        _v("CHUNK_PRUNE_SELECTIVITY", "float", "0.9",
+           "surviving-chunk fraction ABOVE which chunk pruning is skipped "
+           "(near-full selections would fragment the content-keyed caches "
+           "for no decode savings)",
+           related=("CHUNK_PRUNE",)),
+        _v("DELTA_SERVE", "flag", "1",
+           "delta-maintained hot aggregates: a cached result whose tables "
+           "only grew refreshes by aggregating the appended chunks alone "
+           "and merging the delta partial (0 = full recompute on every "
+           "append)",
+           related=("DELTA_CACHE_BYTES", "APPEND")),
+        _v("DELTA_CACHE_BYTES", "int", "128 MiB",
+           "byte budget of the worker's delta-maintained aggregate cache",
+           related=("DELTA_SERVE",)),
     ]
 }
 

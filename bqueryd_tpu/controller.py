@@ -75,8 +75,13 @@ CONTROLLER_VERBS = (
     "ping", "loglevel", "info", "kill", "killworkers", "killall",
     "download", "readfile", "execute_code", "sleep", "groupby", "query",
     "trace", "metrics", "slow_queries", "health", "debug_bundle",
-    "autopsy", "timeline", "capacity",
+    "autopsy", "timeline", "capacity", "append",
 )
+
+#: how long an append fan-out may wait for every holder's reply before the
+#: client gets a structured partial-failure error (a client deadline, when
+#: set, wins)
+APPEND_TIMEOUT = 120.0
 
 #: help text for every controller counter — the spec the registry-backed
 #: ``counters`` dict (obs.metrics.RegistryCounters) is built from; the same
@@ -133,6 +138,11 @@ COUNTER_SPECS = {
     "capacity_rebalance_advised":
         "shadow-advisor shard-rebalance recommendations emitted (advisory "
         "only)",
+    "append_requests":
+        "client rpc.append calls accepted for fan-out to shard holders",
+    "append_dispatches":
+        "per-holder append CalcMessages dispatched (one per distinct "
+        "(node, data_dir) replica of the target shard)",
 }
 
 
@@ -236,6 +246,10 @@ class ControllerNode:
         #                                (backoff window): a late reply from
         #                                the failed attempt must not abort
         #                                or double-execute past them
+        # streaming-append fan-out bookkeeping (rpc_append): one segment
+        # per client call, one dispatch token per replica holder
+        self._append_segments = {}    # segment key -> fan-out state
+        self._append_waiters = {}     # dispatch token -> segment key
         self._holder_counts_memo = None  # (ts, counts) scrape-window memo
         # -- planning & admission state -------------------------------------
         from bqueryd_tpu.plan import AdmissionController
@@ -468,6 +482,7 @@ class ControllerNode:
                     self.free_dead_workers()
                     self.retry_stale_dispatches()
                     self.maybe_hedge()
+                    self._sweep_append_segments()
                     # a pending micro-batch window bounds the poll sleep:
                     # the flush must fire when the window closes, not a full
                     # POLLING_TIMEOUT later (closed-loop clients send
@@ -619,6 +634,21 @@ class ControllerNode:
             if not self.files_map[filename]:
                 del self.files_map[filename]
                 self.shard_stats.pop(filename, None)
+        # fail pending append dispatches to the removed holder FAST: the
+        # fan-out cannot complete anymore, and the client would otherwise
+        # wait out the whole segment timeout for a worker that is gone
+        for seg_key, segment in list(self._append_segments.items()):
+            gone = [
+                t for t, w in segment["pending"].items() if w == worker_id
+            ]
+            for t in gone:
+                segment["pending"].pop(t, None)
+                self._append_waiters.pop(t, None)
+                segment["errors"][worker_id] = (
+                    "holder removed (worker lost before confirming)"
+                )
+            if gone and not segment["pending"]:
+                self._finish_append_segment(seg_key, segment)
         # re-queue anything in flight on that worker; a hedged flight
         # collapses onto its surviving side instead (the duplicate is
         # still computing — a fresh dispatch would be redundant)
@@ -1796,6 +1826,22 @@ class ControllerNode:
     def process_worker_result(self, msg, entry=None):
         parent = msg.get("parent_token")
         token = msg.get("token")
+        if token is not None and token in self._append_waiters:
+            # streaming-append fan-out reply: collected per holder, the
+            # client answered once every replica confirmed
+            self._absorb_append_reply(token, msg)
+            return
+        if isinstance(token, str) and token.startswith("append_"):
+            # orphaned append reply: its waiter already failed fast
+            # (holder removal) or timed out — the client was answered.
+            # Matched by the synthetic token prefix, NOT the verb: an
+            # ErrorMessage reply's payload is the traceback, so
+            # isa("append") would miss it and the fall-through would hand
+            # the non-hex dispatch token to reply_rpc_message
+            self.flight.record(
+                "append_reply_orphaned", token=token,
+            )
+            return
         subscribers = self._work_subscribers.get(token)
         if entry is not None and not (
             msg.isa(ErrorMessage) and msg.get("transient")
@@ -2884,6 +2930,157 @@ class ControllerNode:
         from bqueryd_tpu.download import setup_download
 
         setup_download(self, msg)
+
+    # -- streaming append (PR 14) ------------------------------------------
+    def rpc_append(self, msg):
+        """``rpc.append(filename, dataframe_like)``: route the batch to
+        every replica holder of the shard — one dispatch per distinct
+        (node, data_dir), so co-located workers sharing one directory
+        apply it once — and reply when ALL holders confirmed.  Holder
+        stats for the shard are dropped on completion so plan-time pruning
+        never acts on pre-append min/max while fresh WRM stats are in
+        flight.  Replica divergence contract: a holder that fails leaves
+        replicas inconsistent; the error reply names it, and re-issuing
+        the append (or re-downloading the shard) is the repair path."""
+        args, _kwargs = msg.get_args_kwargs()
+        if len(args) != 2:
+            raise ValueError("append needs (filename, dataframe_like)")
+        filename = args[0]
+        holders = sorted(self.files_map.get(filename) or ())
+        if not holders:
+            raise ValueError(
+                f"file {filename!r} is not served by any worker"
+            )
+        # one target per physical replica directory: workers co-located on
+        # one (node, data_dir) serve the SAME bytes — appending through
+        # each would duplicate the rows
+        targets = {}
+        for worker_id in holders:
+            info = self.worker_map.get(worker_id) or {}
+            group = (info.get("node"), info.get("data_dir") or worker_id)
+            targets.setdefault(group, worker_id)
+        deadline = msg.get("deadline")
+        seg_key = f"append_{os.urandom(8).hex()}"
+        segment = {
+            "client_token": msg["token"],
+            "filename": filename,
+            "created": time.time(),
+            "expires": (
+                float(deadline) if deadline is not None
+                else time.time() + APPEND_TIMEOUT
+            ),
+            "pending": {},   # dispatch token -> worker_id
+            "results": {},   # worker_id -> result dict
+            "errors": {},    # worker_id -> error text
+        }
+        for worker_id in sorted(targets.values()):
+            calc = CalcMessage(dict(msg))
+            calc["payload"] = "append"
+            calc["filename"] = filename
+            calc["token"] = f"append_{os.urandom(8).hex()}"
+            calc["worker_id"] = worker_id
+            segment["pending"][calc["token"]] = worker_id
+            self._append_waiters[calc["token"]] = seg_key
+            self.worker_out_messages.setdefault(worker_id, []).append(calc)
+            self.counters["append_dispatches"] += 1
+        self._append_segments[seg_key] = segment
+        self.counters["append_requests"] += 1
+        self.flight.record(
+            "append_fanout", filename=filename,
+            holders=len(segment["pending"]),
+        )
+
+    def _absorb_append_reply(self, token, msg):
+        """One holder's append reply: record it and, when every holder
+        answered, reply to the client (all-ok -> per-holder summary;
+        any failure -> structured error naming the failed holders)."""
+        seg_key = self._append_waiters.pop(token, None)
+        segment = self._append_segments.get(seg_key)
+        if segment is None:
+            return
+        worker_id = segment["pending"].pop(token, None)
+        if worker_id is None:
+            return
+        if msg.isa(ErrorMessage):
+            text = str(msg.get("payload") or "append failed")
+            if "unhandled message payload" in text:
+                # pre-PR-14 worker: its base handler rejects the verb with
+                # a traceback — rewrite into the structured mixed-version
+                # error MIGRATION documents
+                text = (
+                    "UnsupportedVerb: worker predates streaming append "
+                    "(PR 14); upgrade calc workers before using rpc.append"
+                )
+            else:
+                text = (text.strip().splitlines() or ["append failed"])[-1]
+            segment["errors"][worker_id] = text[:300]
+        else:
+            segment["results"][worker_id] = (
+                msg.get_from_binary("result") or {}
+            )
+        if segment["pending"]:
+            return
+        self._finish_append_segment(seg_key, segment)
+
+    def _finish_append_segment(self, seg_key, segment, timeout=False):
+        self._append_segments.pop(seg_key, None)
+        filename = segment["filename"]
+        # pruning safety: advertised pre-append min/max could prune shards
+        # whose NEW rows match — drop the stats until fresh WRMs land
+        # (stats-less shards conservatively match everything)
+        self.shard_stats.pop(filename, None)
+        reply_to = segment["client_token"]
+        if segment["errors"] or timeout:
+            for token in list(self._append_waiters):
+                if self._append_waiters.get(token) == seg_key:
+                    self._append_waiters.pop(token, None)
+            detail = "; ".join(
+                f"{w}: {e}" for w, e in sorted(segment["errors"].items())
+            )
+            if timeout and segment["pending"]:
+                waiting = ", ".join(sorted(segment["pending"].values()))
+                detail = (
+                    f"{detail}; " if detail else ""
+                ) + f"no reply from {waiting}"
+            ok_part = (
+                f" ({len(segment['results'])} holder(s) DID apply the "
+                f"append — replicas may have diverged; re-issue the "
+                f"append or re-download the shard)"
+                if segment["results"] else ""
+            )
+            err = ErrorMessage({"token": reply_to})
+            err["payload"] = (
+                f"append {filename!r} failed: {detail}{ok_part}"
+            )
+            self.flight.record(
+                "append_failed", filename=filename, detail=detail[:200],
+            )
+            self.reply_rpc_message(reply_to, err)
+            return
+        reply = Message({"token": reply_to, "payload": "append"})
+        reply.add_as_binary(
+            "result",
+            {
+                "filename": filename,
+                "holders": segment["results"],
+                "appended": max(
+                    (r.get("appended", 0) for r in
+                     segment["results"].values()),
+                    default=0,
+                ),
+            },
+        )
+        self.reply_rpc_message(reply_to, reply)
+
+    def _sweep_append_segments(self):
+        """Fail append fan-outs whose holders never answered (dead worker,
+        lost reply) instead of hanging the client past its RPC timeout."""
+        if not self._append_segments:
+            return
+        now = time.time()
+        for seg_key, segment in list(self._append_segments.items()):
+            if now > segment["expires"]:
+                self._finish_append_segment(seg_key, segment, timeout=True)
 
     def release_ticket_waiters(self, ticket, error=None):
         segment = self.rpc_segments.pop(f"ticket_{ticket}", None)

@@ -118,8 +118,10 @@ ENVELOPE_SCHEMA = {
     "strategy": "the planner's kernel-strategy hint, echoed on the reply",
     "effective_strategy": "physical kernel route the worker ran post-guards "
                           "(matmul/scatter/sort/host; 'cached' = result-"
-                          "cache hit, nothing compiled) — hints may "
-                          "normalize",
+                          "cache hit, nothing compiled; 'delta' = delta-"
+                          "maintained refresh: only appended chunks "
+                          "re-aggregated, merged into the cached result) — "
+                          "hints may normalize",
     "merge_mode": "how the reply's partials merged: 'device' (ICI-mesh "
                   "collective, final table only fetched), 'host' "
                   "(hostmerge.merge_payloads fallback), 'none' (single "

@@ -141,6 +141,8 @@ from bqueryd_tpu.ops.groupby import (  # noqa: E402
 from bqueryd_tpu.ops.predicates import (  # noqa: E402
     WHERE_OPS,
     build_mask,
+    chunk_pruned_table,
+    chunk_selection,
     shard_can_match,
     term_mask,
     translate_value,
@@ -173,6 +175,8 @@ __all__ = [
     "finalize",
     "WHERE_OPS",
     "build_mask",
+    "chunk_pruned_table",
+    "chunk_selection",
     "shard_can_match",
     "term_mask",
     "translate_value",

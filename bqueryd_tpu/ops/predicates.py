@@ -24,7 +24,14 @@ matching row before anything is decompressed or shipped to the device.
 """
 
 
+import os
+
 WHERE_OPS = ("==", "!=", "<", "<=", ">", ">=", "in", "not in")
+
+#: ops the per-chunk zone maps can prune on (plan.stats.zone_can_match);
+#: ``!=``/``not in`` never prune — NaN rows satisfy them but are invisible
+#: to the NaN-skipping zone maps
+ZONE_PRUNABLE_OPS = ("==", "<", "<=", ">", ">=", "in")
 
 
 def _to_ns(value):
@@ -124,6 +131,83 @@ def build_mask(table, where_terms_list, column_getter=None):
         m = term_mask(get(column), op, phys)
         mask = m if mask is None else (mask & m)
     return mask
+
+
+def chunk_prune_enabled():
+    """Chunk-granular zone-map pruning kill switch
+    (``BQUERYD_TPU_CHUNK_PRUNE``, default on)."""
+    return os.environ.get("BQUERYD_TPU_CHUNK_PRUNE", "1") == "1"
+
+
+def chunk_prune_selectivity():
+    """Surviving-chunk fraction ABOVE which pruning is skipped
+    (``BQUERYD_TPU_CHUNK_PRUNE_SELECTIVITY``, default 0.9): a filter that
+    keeps nearly every chunk would fragment the content-keyed caches for
+    no decode savings."""
+    try:
+        return float(
+            os.environ.get("BQUERYD_TPU_CHUNK_PRUNE_SELECTIVITY", "0.9")
+        )
+    except ValueError:
+        return 0.9
+
+
+def chunk_selection(table, where_terms_list):
+    """Boolean keep-mask over the table's committed chunk grid for an
+    AND-ed term list, or None when nothing is prunable (no zone maps, no
+    prunable ops, single chunk).  A False entry is PROOF (from per-chunk
+    min/max) that no row of that chunk satisfies the conjunction."""
+    import numpy as np
+
+    from bqueryd_tpu.plan.stats import zone_can_match
+
+    counts = getattr(table, "chunk_rows", lambda: None)()
+    if counts is None or len(counts) <= 1:
+        return None
+    keep = np.ones(len(counts), dtype=bool)
+    prunable = False
+    for term in where_terms_list or []:
+        try:
+            column, op, value = term
+        except (TypeError, ValueError):
+            continue
+        if op not in ZONE_PRUNABLE_OPS or column not in table:
+            continue
+        maps = table.chunk_zone_maps(column)
+        if maps is None or len(maps) != len(counts):
+            continue
+        phys = translate_value(table, column, value, op)
+        for i, zone in enumerate(maps):
+            if not keep[i] or zone is None:
+                continue
+            if not zone_can_match(zone[0], zone[1], op, phys):
+                keep[i] = False
+                prunable = True
+    return keep if prunable else None
+
+
+def chunk_pruned_table(table, where_terms_list):
+    """``(table_or_view, chunks_decoded, chunks_skipped)``: the zone-map
+    pruning seam the worker's execute paths call.  Returns the original
+    table untouched (counters still meaningful) unless pruning is enabled,
+    at least one chunk is provably unmatchable, and the surviving fraction
+    sits at or under the selectivity floor.  NEVER use with basket
+    expansion (``expand_filter_column``): expansion re-selects rows of the
+    same basket that live in pruned chunks."""
+    counts = getattr(table, "chunk_rows", lambda: None)()
+    total = len(counts) if counts is not None else 0
+    if not chunk_prune_enabled():
+        return table, 0, 0
+    keep = chunk_selection(table, where_terms_list)
+    if keep is None:
+        return table, total, 0
+    selected = int(keep.sum())
+    if selected == total or selected / total > chunk_prune_selectivity():
+        return table, total, 0
+    import numpy as np
+
+    view = table.chunk_view(np.flatnonzero(keep))
+    return view, selected, total - selected
 
 
 def shard_can_match(table, where_terms_list):

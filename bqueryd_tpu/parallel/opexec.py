@@ -349,6 +349,10 @@ class DagExecutor:
         self.last_effective_strategy = None
         #: "none" (single payload) or "host" (value-keyed cross-shard merge)
         self.last_merge_mode = None
+        #: per-shard (decoded, skipped) chunk-prune counts of the last
+        #: execute() (list.append is atomic — shards run on the pool);
+        #: the worker folds the totals into its chunk counters
+        self._prune_counts = []
 
     def _phase(self, name):
         if self.timer is None:
@@ -363,6 +367,7 @@ class DagExecutor:
 
         self.timer = timer
         self.last_effective_strategy = None
+        self._prune_counts = []
         payloads = pipeline.map_ordered(
             lambda t: self.execute_shard(t, dag), tables
         )
@@ -549,12 +554,20 @@ class DagExecutor:
                     f"{kind!r} is not defined for datetime column {in_col!r}"
                 )
 
-        state = _ShardState(table, dag)
         with self._phase("prune"):
-            if dag.scan.pushdown and not ops.shard_can_match(
-                table, dag.scan.pushdown
-            ):
-                return ResultPayload.empty()
+            if dag.scan.pushdown:
+                if not ops.shard_can_match(table, dag.scan.pushdown):
+                    return ResultPayload.empty()
+                # chunk-granular zone-map pruning on the PUSHDOWN terms
+                # (pre-join fact predicates): joins/top-k/windows only
+                # narrow rows further, so a chunk no pushdown row survives
+                # contributes nothing to any downstream operator
+                table, decoded, skipped = ops.chunk_pruned_table(
+                    table, dag.scan.pushdown
+                )
+                if decoded or skipped:
+                    self._prune_counts.append((decoded, skipped))
+        state = _ShardState(table, dag)
         with self._phase("mask"):
             mask = ops.build_mask(table, dag.scan.pushdown)
             mask = None if mask is None else np.asarray(mask, dtype=bool)

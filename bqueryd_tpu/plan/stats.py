@@ -57,17 +57,109 @@ def column_cardinality(table, name):
     return _sidecar_cardinality(table, name)
 
 
-def gather_table_stats(table):
-    """One shard's advertised stats (JSON-safe dict)."""
+def _chunk_prefix_sig(table, name, count):
+    """CRC of the identity (offset, csize, crc) of the first ``count``
+    committed chunks of a column — the metadata-only fingerprint the
+    incremental gather validates against, so a shard REPLACED in place
+    (same name, same-or-more chunks, different bytes) can never pass as
+    an append and fold stale min/max into fresh advertisements."""
+    import zlib
+
+    committed = getattr(table, "committed_chunks", None)
+    if committed is None:
+        return None
+    chunks = committed(name)
+    if chunks is None or len(chunks) < count:
+        return None
+    sig = 0
+    for c in chunks[:count]:
+        sig = zlib.crc32(
+            f"{c.get('offset')}:{c.get('csize')}:{c.get('crc')};".encode(),
+            sig,
+        )
+    return sig
+
+
+def gather_table_stats(table, prev=None):
+    """One shard's advertised stats (JSON-safe dict).
+
+    ``prev`` is the previous snapshot for the same shard (if any): when the
+    table only GREW since it was taken (chunk counts monotonic AND the old
+    chunks an unchanged prefix, validated per column by the metadata-only
+    ``sig`` fingerprint — the streaming-append signature), per-column work
+    is incremental: min/max fold the NEW chunks' zone maps into the
+    previous bounds and an unchanged column's cardinality probe (the
+    factorize-sidecar npz open, the one non-O(1) read here) is skipped.
+    Any non-growth change — including an in-place replacement with
+    different content — fails the fingerprint and falls back to the full
+    gather."""
+    prev_cols = (prev or {}).get("cols") if isinstance(prev, dict) else None
+    if not isinstance(prev_cols, dict):
+        prev_cols = {}
     cols = {}
     for name in table.names:
-        entry = {"kind": table.kind(name)}
-        stats = table.col_stats(name)
-        if stats is not None:
-            entry["min"], entry["max"] = stats
-        card = column_cardinality(table, name)
-        if card is not None:
-            entry["card"] = card
+        kind = table.kind(name)
+        entry = {"kind": kind}
+        counts = table.chunk_rows(name) if hasattr(table, "chunk_rows") \
+            else None
+        nchunks = len(counts) if counts is not None else None
+        if nchunks is not None:
+            entry["chunks"] = nchunks
+            entry["sig"] = _chunk_prefix_sig(table, name, nchunks)
+        pentry = prev_cols.get(name)
+        grown = (
+            isinstance(pentry, dict)
+            and pentry.get("kind") == kind
+            and nchunks is not None
+            and isinstance(pentry.get("chunks"), int)
+            and nchunks >= pentry["chunks"]
+            # the old chunks must be an UNCHANGED prefix of the current
+            # index: an in-place replacement with >= chunks is not growth
+            and pentry.get("sig") is not None
+            and _chunk_prefix_sig(table, name, pentry["chunks"])
+            == pentry["sig"]
+        )
+        if (
+            grown
+            and "min" in pentry
+            and "max" in pentry
+            and nchunks > pentry["chunks"]
+        ):
+            # fold only the appended chunks' zone maps into the previous
+            # bounds; a new chunk without a zone map degrades to col_stats
+            maps = table.chunk_zone_maps(name)
+            new = (
+                maps[pentry["chunks"]:] if maps is not None else [None]
+            )
+            if all(m is not None for m in new):
+                entry["min"] = min(
+                    [pentry["min"]] + [m[0] for m in new]
+                )
+                entry["max"] = max(
+                    [pentry["max"]] + [m[1] for m in new]
+                )
+        if "min" not in entry:
+            stats = table.col_stats(name)
+            if stats is not None:
+                entry["min"], entry["max"] = stats
+        if kind == "dict":
+            # exact and O(1): the persistent dictionary only ever grows
+            dictionary = table.dictionary(name)
+            if dictionary is not None:
+                entry["card"] = len(dictionary)
+        elif grown and nchunks == pentry["chunks"] and "card" in pentry:
+            # unchanged column: reuse instead of re-opening the sidecar
+            entry["card"] = pentry["card"]
+        elif grown and nchunks > pentry["chunks"]:
+            # appended column: its factorize sidecar is provably stale
+            # (the stamp covers the data bytes), so the probe can only
+            # miss — skip it; cardinality re-advertises after the next
+            # query re-factorizes and stores a fresh sidecar
+            pass
+        else:
+            card = column_cardinality(table, name)
+            if card is not None:
+                entry["card"] = card
         cols[name] = entry
     return {"rows": int(table.nrows), "cols": cols}
 
@@ -94,6 +186,18 @@ class StatsCollector:
         self.min_refresh_s = (
             self.MIN_REFRESH_S if min_refresh_s is None else min_refresh_s
         )
+        self._snapshot = None
+        self._snapshot_names = None
+        self._snapshot_ts = 0.0
+
+    def invalidate(self):
+        """Drop the snapshot window so the NEXT collect re-stamps every
+        shard immediately.  Called by the worker's append path: a grown
+        shard must advertise fresh stats on the next heartbeat, not after
+        ``min_refresh_s`` — stale controller-side min/max would prune
+        shards whose appended rows now match.  Per-shard memos are kept:
+        the re-stamp detects the one grown shard and refreshes it
+        incrementally."""
         self._snapshot = None
         self._snapshot_names = None
         self._snapshot_ts = 0.0
@@ -139,7 +243,12 @@ class StatsCollector:
                 if hit is not None and hit[0] == stamp:
                     out[name] = hit[1]
                     continue
-                stats = gather_table_stats(table)
+                # stale memo: re-gather INCREMENTALLY against the previous
+                # snapshot (append-grown shards fold only the new chunks'
+                # zone maps and skip unchanged cardinality probes)
+                stats = gather_table_stats(
+                    table, prev=hit[1] if hit is not None else None
+                )
                 self._memo[name] = (stamp, stats)
                 out[name] = stats
             except Exception:
@@ -160,6 +269,37 @@ def _default_open(rootdir):
     from bqueryd_tpu.storage.ctable import ctable
 
     return ctable(rootdir, mode="r", auto_cache=True)
+
+
+def zone_can_match(lo, hi, op, value):
+    """Per-chunk twin of :func:`stats_can_match`: True unless NO value in
+    the chunk's ``[lo, hi]`` zone map can satisfy ``(op, value)``.  Values
+    are PHYSICAL (the worker translates datetimes to int64 ns before
+    calling); anything incomparable conservatively matches — garbage must
+    read as "cannot prune", never raise mid-query.
+
+    Only the provable ops prune.  ``!=``/``not in`` are deliberately
+    excluded even when ``lo == hi``: a float chunk's zone map skips NaNs,
+    and NaN rows *do* satisfy ``!=`` — pruning on bounds alone would drop
+    them."""
+    try:
+        if op == "==":
+            return not (value < lo or value > hi)
+        if op == ">":
+            return hi > value
+        if op == ">=":
+            return hi >= value
+        if op == "<":
+            return lo < value
+        if op == "<=":
+            return lo <= value
+        if op == "in":
+            if isinstance(value, (list, tuple, set, frozenset)) and value:
+                return any(not (v < lo or v > hi) for v in value)
+            return True
+    except TypeError:
+        return True
+    return True
 
 
 def stats_can_match(stats, where_terms):

@@ -381,6 +381,24 @@ class RPC:
             kwargs["priority"] = priority
         return self._rpc("query", (spec,), kwargs)
 
+    # -- streaming ingest --------------------------------------------------
+    def append(self, filename, data, deadline=None):
+        """Append a dataframe-like batch of rows to a served shard: the
+        controller routes the frame to every replica holder of
+        ``filename`` (one per distinct (node, data_dir)) and replies once
+        ALL holders confirmed.  Returns ``{"filename", "appended",
+        "holders": {worker: {...}}}``.  Worker-side, the committed row
+        count flips atomically after the chunk data lands, so queries
+        racing the append see either the pre- or post-append snapshot —
+        never a torn one; repeat queries after the append are served by
+        delta maintenance (only the appended chunks re-aggregate).  A
+        holder failure raises with the failed workers named — replicas
+        may then have diverged; re-issue the append or re-download."""
+        kwargs = {}
+        if deadline is not None:
+            kwargs["deadline"] = deadline
+        return self._rpc("append", (filename, data), kwargs)
+
     # -- query autopsy -----------------------------------------------------
     def autopsy(self, trace_id=None):
         """The attributed critical-path breakdown for one query (default:

@@ -400,38 +400,185 @@ class ctable:
             uniques=uniques,
         )
 
-    def column_raw(self, name):
-        """Physical column values as one contiguous ndarray: int32 codes for
-        dict columns, int64 ns for datetimes, the stored dtype otherwise.
-        This is the array the TPU kernels consume."""
+    def committed_chunks(self, name):
+        """This instance's committed chunk prefix for a column: the chunks
+        covering exactly ``self.nrows`` rows.  Appends commit through the
+        final meta.json rename, so a reader opened mid-append may see extra
+        UNCOMMITTED chunks in the column index — they are excluded here,
+        which is what gives concurrent readers a consistent row-count
+        snapshot.  None when the index cannot cover the committed row count
+        on a chunk boundary (truncated/torn data — the caller raises)."""
+        col = self._columns[name]
+        acc = 0
+        out = []
+        for c in col.chunks:
+            if acc >= self.nrows:
+                break
+            out.append(c)
+            acc += int(c["nrows"])
+        return out if acc == self.nrows else None
+
+    def chunk_rows(self, name=None):
+        """Per-chunk row counts of the committed chunk grid (all columns of
+        a table share one grid: every append chunks all columns by the same
+        batch + chunklen), or None when the grid is unreadable.  The grid is
+        what zone-map pruning and delta tails select over."""
+        if name is None:
+            if not self._order:
+                return None
+            name = self._order[0]
+        chunks = self.committed_chunks(name)
+        if chunks is None:
+            return None
+        return [int(c["nrows"]) for c in chunks]
+
+    def chunk_zone_maps(self, name):
+        """Per-chunk ``(min, max)`` zone maps over the committed chunks of a
+        numeric/datetime column (physical values; datetimes in int64 ns), or
+        None when the column kind carries no zone maps.  Individual entries
+        are None for chunks written before zone maps existed or holding no
+        stats-able values (all-NaN/NaT) — those conservatively match every
+        predicate."""
+        col = self._columns[name]
+        if col.kind not in (KIND_NUMERIC, KIND_DATETIME):
+            return None
+        chunks = self.committed_chunks(name)
+        if chunks is None:
+            return None
+        return [
+            (c["min"], c["max"])
+            if c.get("min") is not None and c.get("max") is not None
+            else None
+            for c in chunks
+        ]
+
+    def chunk_view(self, chunk_ids):
+        """A :class:`ChunkView` over the given committed-chunk indices."""
+        return ChunkView(self, chunk_ids)
+
+    def tail_view(self, start_row):
+        """A :class:`ChunkView` of the rows appended after ``start_row``,
+        or None when ``start_row`` does not fall on a chunk boundary (only
+        append-grown tables have boundary-aligned tails — anything else
+        means a rewrite, and the caller must recompute)."""
+        counts = self.chunk_rows()
+        if counts is None:
+            return None
+        acc = 0
+        for i, n in enumerate(counts):
+            if acc == start_row:
+                return ChunkView(self, range(i, len(counts)))
+            acc += n
+        if acc == start_row:  # tail starts exactly at the end: empty view
+            return ChunkView(self, ())
+        return None
+
+    def _column_cache_key(self, name, extra=()):
+        """Content key of one column's decoded bytes.  Beyond the data
+        file's (mtime, size), the key carries this INSTANCE's committed
+        chunk count + row count: a reader opened mid-append decodes only
+        its snapshot prefix, and caching that truncated array under the
+        grown file's stat alone would serve stale bytes to the next reader
+        of the fully-committed table."""
         col = self._columns[name]
         data_path = self._col_path(name, "data.tpc")
         st = os.stat(data_path) if os.path.exists(data_path) else None
-        key = (
+        return (
             os.path.realpath(self.rootdir),
             name,
             st.st_mtime_ns if st else 0,
             st.st_size if st else 0,
-        )
+            len(col.chunks),
+            self.nrows,
+        ) + tuple(extra)
+
+    def column_raw(self, name):
+        """Physical column values as one contiguous ndarray: int32 codes for
+        dict columns, int64 ns for datetimes, the stored dtype otherwise.
+        This is the array the TPU kernels consume.  Decodes the committed
+        snapshot only: chunks an in-flight append has written past this
+        instance's meta.json row count are ignored."""
+        col = self._columns[name]
+        data_path = self._col_path(name, "data.tpc")
+        key = self._column_cache_key(name)
         if self.auto_cache:
             hit = _cache_get(key)
             if hit is not None:
                 return hit
         dtype = np.dtype(col.dtype)
-        chunk_rows = sum(c["nrows"] for c in col.chunks)
-        if chunk_rows != self.nrows:
+        chunks = self.committed_chunks(name)
+        if chunks is None:
+            chunk_rows = sum(c["nrows"] for c in col.chunks)
             raise IOError(
                 f"inconsistent table {self.rootdir!r}: column {name!r} has "
                 f"{chunk_rows} rows in its chunk index but meta says {self.nrows}"
             )
         out = np.empty(self.nrows, dtype=dtype)
-        if col.chunks:
-            with open(data_path, "rb") as f:
-                file_buf = f.read()
-            codec.decode_column_into(
-                file_buf, col.chunks, dtype.itemsize, self.codec_id, out,
-                self.nthreads,
+        self._read_decode_chunks(name, chunks, out)
+        if self.auto_cache:
+            out.setflags(write=False)
+            _cache_put(key, out)
+        return out
+
+    def _read_decode_chunks(self, name, chunks, out):
+        """Read + decode an ordered chunk subset into ``out``.  Reads each
+        file-contiguous run with one seek+read and rebases offsets into the
+        compact buffer — the decoder needs back-to-back chunks, and the
+        on-disk index may carry byte gaps (pruned selections, orphaned
+        bytes left by a repaired torn append)."""
+        if not chunks:
+            return
+        dtype = out.dtype
+        parts = []
+        runs = [[chunks[0]]]
+        for c in chunks[1:]:
+            prev = runs[-1][-1]
+            if c["offset"] == prev["offset"] + prev["csize"]:
+                runs[-1].append(c)
+            else:
+                runs.append([c])
+        rebased = []
+        pos = 0
+        data_path = self._col_path(name, "data.tpc")
+        with open(data_path, "rb") as f:
+            for run in runs:
+                start = run[0]["offset"]
+                length = run[-1]["offset"] + run[-1]["csize"] - start
+                f.seek(start)
+                parts.append(f.read(length))
+                for c in run:
+                    nc = dict(c)
+                    nc["offset"] = pos + (c["offset"] - start)
+                    rebased.append(nc)
+                pos += length
+        codec.decode_column_into(
+            b"".join(parts) if len(parts) > 1 else parts[0], rebased,
+            dtype.itemsize, self.codec_id, out, self.nthreads,
+        )
+
+    def column_raw_chunks(self, name, chunk_ids):
+        """Decode only the given committed-chunk indices (ascending) of a
+        column, returning their rows concatenated — the zone-map-pruning /
+        delta-tail decode path.  Only the selected chunks' byte ranges are
+        read and decompressed; cached like :meth:`column_raw`, keyed
+        additionally by the chunk selection."""
+        chunk_ids = [int(i) for i in chunk_ids]
+        col = self._columns[name]
+        key = self._column_cache_key(name, extra=("sel", tuple(chunk_ids)))
+        if self.auto_cache:
+            hit = _cache_get(key)
+            if hit is not None:
+                return hit
+        snap = self.committed_chunks(name)
+        if snap is None:
+            raise IOError(
+                f"inconsistent table {self.rootdir!r}: column {name!r} "
+                f"chunk index does not cover the committed row count"
             )
+        chosen = [snap[i] for i in chunk_ids]
+        dtype = np.dtype(col.dtype)
+        out = np.empty(sum(c["nrows"] for c in chosen), dtype=dtype)
+        self._read_decode_chunks(name, chosen, out)
         if self.auto_cache:
             out.setflags(write=False)
             _cache_put(key, out)
@@ -464,18 +611,7 @@ class ctable:
     def column(self, name):
         """Logical column values: strings decoded from the dictionary,
         datetimes as datetime64[ns]."""
-        col = self._columns[name]
-        raw = self.column_raw(name)
-        if col.kind == KIND_DICT:
-            dictionary = np.asarray(self.dictionary(name), dtype=object)
-            out = np.empty(len(raw), dtype=object)
-            valid = raw >= 0
-            out[valid] = dictionary[raw[valid]]
-            out[~valid] = None
-            return out
-        if col.kind == KIND_DATETIME:
-            return raw.view("datetime64[ns]")
-        return raw
+        return _logical_values(self, name, self.column_raw(name))
 
     def __getitem__(self, name):
         return self.column(name)
@@ -502,7 +638,12 @@ class ctable:
                 # NaT is INT64_MIN in the ns view; it must not poison vmin
                 stat_values = values[values != np.iinfo(np.int64).min]
             if len(stat_values):
-                with np.errstate(all="ignore"):
+                import warnings
+
+                with np.errstate(all="ignore"), warnings.catch_warnings():
+                    # all-NaN slices legitimately yield NaN bounds (dropped
+                    # below); the RuntimeWarning is noise
+                    warnings.simplefilter("ignore", RuntimeWarning)
                     lo = np.nanmin(stat_values)
                     hi = np.nanmax(stat_values)
                 if not (isinstance(lo, np.floating) and np.isnan(lo)):
@@ -526,6 +667,34 @@ class ctable:
                     "nrows": len(part),
                     "crc": zlib.crc32(buf) & 0xFFFFFFFF,
                 }
+                # per-chunk zone map (numeric/datetime): min/max over THIS
+                # chunk's values, NaN/NaT-skipped like the column stats —
+                # what query-time chunk pruning reads to prove a predicate
+                # cannot touch the chunk.  Chunks without one (legacy
+                # tables, all-null chunks) conservatively match everything.
+                if (
+                    col.kind in (KIND_NUMERIC, KIND_DATETIME)
+                    and dtype.kind in "iuf"
+                    and len(part)
+                ):
+                    zpart = part
+                    if col.kind == KIND_DATETIME:
+                        zpart = part[part != np.iinfo(np.int64).min]
+                    if len(zpart):
+                        import warnings
+
+                        with np.errstate(all="ignore"), \
+                                warnings.catch_warnings():
+                            warnings.simplefilter(
+                                "ignore", RuntimeWarning
+                            )
+                            zlo = np.nanmin(zpart)
+                            zhi = np.nanmax(zpart)
+                        if not (
+                            isinstance(zlo, np.floating) and np.isnan(zlo)
+                        ):
+                            chunk["min"] = zlo.item()
+                            chunk["max"] = zhi.item()
                 # A fallback writer may use a different codec than the table
                 # default (e.g. zlib instead of LZ4 without the native lib);
                 # record it per chunk so mixed tables stay readable.
@@ -535,11 +704,35 @@ class ctable:
                 offset += len(buf)
         _atomic_json_dump(col.to_json(), self._col_path(name, "meta.json"))
 
+    def _truncate_uncommitted(self):
+        """Drop chunk-index entries past the committed row count: a crash
+        mid-append leaves some columns with chunks that the final meta.json
+        rename never committed, and appending fresh batches on top of a
+        torn index would desynchronize the chunk grid across columns.  The
+        orphaned data-file bytes stay (appends write at the file end, so
+        chunk offsets remain exact); only the index is repaired."""
+        for name in self._order:
+            col = self._columns[name]
+            committed = self.committed_chunks(name)
+            if committed is not None and len(committed) < len(col.chunks):
+                col.chunks = committed
+                _atomic_json_dump(
+                    col.to_json(), self._col_path(name, "meta.json")
+                )
+
     def append_dataframe(self, df):
-        """Append a pandas DataFrame; creates columns on first append."""
+        """Append a pandas DataFrame; creates columns on first append.
+
+        Atomicity contract: column data + chunk indexes land first, the
+        meta.json row count last (atomic rename) — readers opened mid-append
+        keep a consistent pre-append snapshot (:meth:`committed_chunks`),
+        and a crash between the two leaves uncommitted chunks that the next
+        append repairs via :meth:`_truncate_uncommitted`."""
         if self.mode == "r":
             raise IOError("table opened read-only")
         first = not self._columns
+        if not first:
+            self._truncate_uncommitted()
         if first:
             for name in df.columns:
                 kind, phys_dtype = _classify_dtype(df[name].dtype)
@@ -590,6 +783,18 @@ class ctable:
         self.nrows += len(df)
         self._write_meta()
 
+    def append(self, data):
+        """Append rows from a dataframe-like: a pandas DataFrame, or any
+        mapping of column name -> array-like (converted in column order).
+        The streaming-ingest entry point (``rpc.append`` lands here)."""
+        pd = _pd()
+        if not isinstance(data, pd.DataFrame):
+            data = pd.DataFrame(
+                dict(data), columns=self._order or None
+            )
+        self.append_dataframe(data)
+        return len(data)
+
     def flush(self):
         self._write_meta()
 
@@ -600,6 +805,160 @@ class ctable:
         ct = cls(rootdir, mode=mode, chunklen=chunklen, codec_id=codec_id)
         ct.append_dataframe(df)
         return ct
+
+
+def _logical_values(table, name, raw):
+    """Physical -> logical values for one column (shared by ctable and
+    ChunkView): dictionary decode for dict columns, datetime64 view for
+    datetimes, passthrough otherwise."""
+    kind = table.kind(name)
+    if kind == KIND_DICT:
+        dictionary = np.asarray(table.dictionary(name), dtype=object)
+        out = np.empty(len(raw), dtype=object)
+        valid = raw >= 0
+        out[valid] = dictionary[raw[valid]]
+        out[~valid] = None
+        return out
+    if kind == KIND_DATETIME:
+        return raw.view("datetime64[ns]")
+    return raw
+
+
+class ChunkView:
+    """Read-only row subset of a ctable at chunk granularity.
+
+    The two streaming-ingest consumers:
+
+    * **zone-map pruning** — a selective predicate whose per-chunk min/max
+      prove most chunks unmatchable executes over a view of only the
+      surviving chunks, so storage decode / alignment / H2D touch a
+      fraction of the table (:func:`bqueryd_tpu.ops.predicates.
+      chunk_pruned_table`);
+    * **delta maintenance** — the chunks an append added (named by
+      :func:`bqueryd_tpu.ops.workingset.growth_since`, viewed via
+      :meth:`ctable.chunk_view`) re-aggregate alone, and the delta partial
+      merges into the cached result; :meth:`ctable.tail_view` is the
+      storage-level convenience for the same "rows after N" selection.
+
+    The view quacks like a read-only table for every query-time consumer
+    (engine, mesh executor, DAG executor): ``column_raw`` decodes only the
+    selected chunks, ``col_stats`` folds the selected chunks' zone maps
+    (falling back to the parent's conservative column stats), dictionaries
+    and dtypes delegate.  It deliberately exposes NO sidecar methods
+    (``factor_stamp``/``factor_cache_load``), so factorize caching falls
+    back to the in-memory layer keyed by the view's own cache identity —
+    a sidecar stored for a chunk subset would poison full-table loads.
+    Row order is preserved (chunks ascending), so float reductions over
+    the surviving rows are bit-identical to the masked full-table pass.
+    """
+
+    def __init__(self, parent, chunk_ids):
+        self.parent = parent
+        self.chunk_ids = sorted(int(i) for i in chunk_ids)
+        counts = parent.chunk_rows()
+        if counts is None:
+            raise IOError(
+                f"table {parent.rootdir!r} has no readable chunk grid"
+            )
+        if self.chunk_ids and self.chunk_ids[-1] >= len(counts):
+            raise IndexError(
+                f"chunk id {self.chunk_ids[-1]} out of range "
+                f"({len(counts)} committed chunks)"
+            )
+        self.nrows = sum(counts[i] for i in self.chunk_ids)
+        self.rootdir = None  # table_cache_key falls through to the token
+        self.mode = "r"
+        self.auto_cache = parent.auto_cache
+        # deterministic cache identity: parent meta identity + row count +
+        # the chunk selection — an appended/rewritten parent (or a
+        # different selection) yields a different token, so every
+        # content-keyed cache (factorize, align, codes, blocks) invalidates
+        # exactly like it does for real tables
+        pkey = rootdir_cache_key(getattr(parent, "rootdir", None))
+        if pkey is None:
+            pkey = ("unstable", os.urandom(8).hex())
+        sig = zlib.crc32(
+            np.asarray(self.chunk_ids, dtype=np.int64).tobytes()
+        )
+        self._bqueryd_cache_token = (
+            f"{pkey}|r{int(parent.nrows)}|"
+            f"c{len(self.chunk_ids)}:{sig:08x}"
+        )
+
+    # -- delegated metadata ------------------------------------------------
+    @property
+    def names(self):
+        return self.parent.names
+
+    def __len__(self):
+        return self.nrows
+
+    def __contains__(self, name):
+        return name in self.parent
+
+    def kind(self, name):
+        return self.parent.kind(name)
+
+    def physical_dtype(self, name):
+        return self.parent.physical_dtype(name)
+
+    def dictionary(self, name):
+        return self.parent.dictionary(name)
+
+    def dict_lookup(self, name):
+        return self.parent.dict_lookup(name)
+
+    def chunk_rows(self, name=None):
+        counts = self.parent.chunk_rows(name)
+        if counts is None:
+            return None
+        return [counts[i] for i in self.chunk_ids]
+
+    def chunk_zone_maps(self, name):
+        maps = self.parent.chunk_zone_maps(name)
+        if maps is None:
+            return None
+        return [maps[i] for i in self.chunk_ids]
+
+    def col_stats(self, name):
+        """(min, max) over the SELECTED chunks' zone maps when every
+        selected chunk carries one; the parent's column-level stats (a
+        conservative superset range) otherwise."""
+        maps = self.chunk_zone_maps(name)
+        if maps and all(m is not None for m in maps):
+            return (
+                min(m[0] for m in maps),
+                max(m[1] for m in maps),
+            )
+        return self.parent.col_stats(name)
+
+    # -- data --------------------------------------------------------------
+    def column_raw(self, name):
+        return self.parent.column_raw_chunks(name, self.chunk_ids)
+
+    def column(self, name):
+        return _logical_values(self.parent, name, self.column_raw(name))
+
+    def __getitem__(self, name):
+        return self.column(name)
+
+    def prefetch(self, names, submit=None):
+        """Same contract as :meth:`ctable.prefetch`, decoding only the
+        selected chunks — the executor's stage-1 prefetch works on views."""
+        if submit is None:
+            from bqueryd_tpu.parallel import pipeline
+
+            submit = pipeline.submit
+
+        def decode(name):
+            from bqueryd_tpu.parallel import pipeline
+
+            with pipeline.stage("decode"):
+                return self.column_raw(name)
+
+        return [
+            submit(decode, name) for name in names if name in self.parent
+        ]
 
 
 def _classify_dtype(dtype):

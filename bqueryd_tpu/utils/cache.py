@@ -75,6 +75,18 @@ class BytesCappedCache:
             self._sizes[key] = size
             self._bytes += size
 
+    def delete(self, key):
+        """Drop one entry (no-op when absent); returns whether it existed.
+        Not counted as an eviction — deletions are caller-driven
+        invalidation (e.g. a delta-cache entry whose table was rewritten),
+        not budget pressure."""
+        with self._lock:
+            if key not in self._data:
+                return False
+            self._data.pop(key)
+            self._bytes -= self._sizes.pop(key)
+            return True
+
     def evict_bytes(self, target_bytes):
         """Evict LRU entries until at least ``target_bytes`` of accounted
         cache bytes are freed (or the cache is empty).  Returns

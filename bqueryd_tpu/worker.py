@@ -869,6 +869,9 @@ class WorkerBase:
         result_cache = getattr(self, "_result_cache", None)
         if result_cache:
             result_cache.clear()
+        delta_cache = getattr(self, "_delta_cache", None)
+        if delta_cache is not None:
+            delta_cache.clear()
         gc.collect()
         try:
             import psutil
@@ -909,6 +912,42 @@ class WorkerNode(WorkerBase):
         self.groupby_queries = self.metrics.counter(
             "bqueryd_tpu_worker_groupby_total",
             "groupby CalcMessages executed by this worker",
+        )
+        # -- streaming ingest (PR 14) ------------------------------------
+        self._delta_cache = None  # DeltaAggCache, built lazily when enabled
+        self._last_chunk_prune = None
+        self.appends_total = self.metrics.counter(
+            "bqueryd_tpu_worker_appends_total",
+            "append CalcMessages applied by this worker",
+        )
+        self.append_rows_total = self.metrics.counter(
+            "bqueryd_tpu_worker_append_rows_total",
+            "rows appended into served shards by this worker",
+        )
+        self.chunks_decoded_total = self.metrics.counter(
+            "bqueryd_tpu_chunks_decoded_total",
+            "storage chunks the zone-map pruning pass kept for decode on "
+            "filtered queries (with chunks_skipped: the decode fraction)",
+        )
+        self.chunks_skipped_total = self.metrics.counter(
+            "bqueryd_tpu_chunks_skipped_total",
+            "storage chunks proven unmatchable by per-chunk zone maps and "
+            "never decoded",
+        )
+        self.delta_refreshes_total = self.metrics.counter(
+            "bqueryd_tpu_delta_refreshes_total",
+            "cached aggregate results refreshed by aggregating only "
+            "appended chunks and merging the delta partial "
+            "(ops.workingset.DeltaAggCache)",
+        )
+        self.metrics.gauge(
+            "bqueryd_tpu_delta_cache_bytes",
+            "serialized payload bytes held by the delta-maintained "
+            "aggregate cache",
+            fn=lambda: (
+                0 if self._delta_cache is None
+                else self._delta_cache.nbytes
+            ),
         )
         self.groupby_seconds = self.metrics.histogram(
             "bqueryd_tpu_worker_groupby_seconds",
@@ -1148,6 +1187,138 @@ class WorkerNode(WorkerBase):
         # explicit False check: an EMPTY BytesCappedCache is len()-falsy
         return None if self._result_cache is False else self._result_cache
 
+    # -- delta-maintained hot aggregates (streaming ingest, PR 14) ---------
+    def delta_cache(self):
+        """The per-worker :class:`~bqueryd_tpu.ops.workingset.DeltaAggCache`
+        (None while BQUERYD_TPU_DELTA_SERVE=0)."""
+        from bqueryd_tpu.ops import workingset
+
+        if not workingset.delta_serve_enabled():
+            return None
+        if self._delta_cache is None:
+            self._delta_cache = workingset.DeltaAggCache()
+        return self._delta_cache
+
+    @staticmethod
+    def _delta_eligible(query):
+        """Shapes whose cached result can be maintained by merging a
+        tail-only partial: plain mergeable aggregations.  Basket expansion
+        re-selects OLD rows when a NEW row of the same basket matches, so
+        its cached result is not tail-refreshable; distinct counts carry
+        value sets the flat merge forms don't cover here."""
+        from bqueryd_tpu import ops
+
+        return (
+            query is not None
+            and query.aggregate
+            and not query.expand_filter_column
+            and all(op in ops.MERGEABLE_OPS for op in query.ops)
+        )
+
+    def _delta_key(self, tables, query):
+        return (
+            tuple(os.path.realpath(t.rootdir) for t in tables),
+            query.signature(),
+        )
+
+    def _serve_delta(self, cache, tables, query, timer):
+        """Serve a grown shard group from the delta cache: aggregate ONLY
+        the appended chunks of each grown table through the ordinary
+        engine path and merge the tail partials into the cached payload.
+        Returns the refreshed serialized payload, or None (no entry /
+        not an append-only growth — the caller recomputes)."""
+        from bqueryd_tpu.models.query import ResultPayload
+        from bqueryd_tpu.parallel import hostmerge
+
+        key = self._delta_key(tables, query)
+        entry = cache.get(key)
+        if entry is None:
+            return None
+        per_table_ids = cache.refresh_ids(entry, tables)
+        if per_table_ids is None:
+            # rewrite/reshard/shrink: the mtime-keyed identity backstop —
+            # drop the entry, recompute fresh (and re-base below)
+            cache.discard(key)
+            return None
+        tails = [
+            table.chunk_view(ids)
+            for table, ids in zip(tables, per_table_ids)
+            if ids
+        ]
+        if not tails:
+            # no growth: identical repeats are the RESULT cache's job —
+            # serving the bytes here would turn the delta cache into a
+            # second result cache that ignores RESULT_CACHE_BYTES=0
+            return None
+        payloads = [ResultPayload.from_bytes(entry["data"])]
+        delta_rows = 0
+        self.engine.timer = timer
+        for view in tails:
+            payloads.append(self.engine.execute_local(view, query))
+            delta_rows += int(view.nrows)
+        with timer.phase("hostmerge"):
+            merged = ResultPayload(hostmerge.merge_payloads(payloads))
+        with timer.phase("serialize"):
+            data = merged.to_bytes()
+        cache.store(key, tables, data)
+        cache.refreshes += 1
+        cache.delta_rows += delta_rows
+        self.delta_refreshes_total.inc()
+        self._last_merge_mode = "host"
+        return data
+
+    def _append_rows(self, msg):
+        """The ``rpc.append`` verb: apply a dataframe-like batch of rows to
+        a locally served shard.  Column data + chunk indexes commit before
+        the meta.json row count (storage.ctable.append_dataframe), so
+        concurrent queries on this worker keep a consistent snapshot; the
+        stats collector window is dropped so the grown shard advertises
+        fresh min/max/cardinality on the next heartbeat."""
+        if os.environ.get("BQUERYD_TPU_APPEND", "1") == "0":
+            raise ValueError(
+                "streaming append disabled on this worker "
+                "(BQUERYD_TPU_APPEND=0)"
+            )
+        from bqueryd_tpu.storage.ctable import ctable
+
+        args, _kwargs = msg.get_args_kwargs()
+        if len(args) != 2:
+            raise ValueError("append needs (filename, dataframe_like)")
+        filename, frame = args
+        rootdir = os.path.realpath(os.path.join(self.data_dir, filename))
+        if not rootdir.startswith(
+            os.path.realpath(self.data_dir) + os.sep
+        ):
+            raise ValueError(f"path {filename!r} escapes data_dir")
+        if not os.path.exists(os.path.join(rootdir, "meta.json")):
+            raise ValueError(f"Path {rootdir} does not exist")
+        table = ctable(rootdir, mode="a")
+        appended = table.append(frame)
+        self.appends_total.inc()
+        self.append_rows_total.inc(appended)
+        collector = self._stats_collector
+        if collector is not None:
+            collector.invalidate()
+        self.flight.record(
+            "append", filename=filename, rows=appended,
+            total=int(table.nrows),
+        )
+        reply = msg.copy()
+        # the request params carry the whole appended frame — echoing them
+        # back worker->controller per holder would double the wire cost
+        reply.pop("params", None)
+        reply.add_as_binary(
+            "result",
+            {
+                "filename": filename,
+                "appended": int(appended),
+                "rows": int(table.nrows),
+                "worker": self.worker_id,
+                "node": self.node_name,
+            },
+        )
+        return reply
+
     def _execute(self, tables, query, timer, strategy=None):
         """Psum-mergeable aggregations (any shard count) -> mesh executor
         (on-device merge + HBM-resident caches); distinct-count / raw-rows
@@ -1176,6 +1347,28 @@ class WorkerNode(WorkerBase):
         # "host" = hostmerge.merge_payloads, "none" = single payload, no
         # merge) — the reply envelope's ``merge_mode`` key
         self._last_merge_mode = None
+        # chunk-granular zone-map pruning: a selective filter whose
+        # per-chunk min/max prove most chunks unmatchable executes over
+        # views of only the surviving chunks — decode, alignment and H2D
+        # shrink proportionally.  Basket expansion is excluded (expansion
+        # re-selects rows of the same basket living in pruned chunks).
+        self._last_chunk_prune = None
+        if query.where_terms and not query.expand_filter_column:
+            from bqueryd_tpu.ops import predicates
+
+            if predicates.chunk_prune_enabled():
+                with timer.phase("prune"):
+                    pruned = [
+                        predicates.chunk_pruned_table(t, query.where_terms)
+                        for t in tables
+                    ]
+                decoded = sum(p[1] for p in pruned)
+                skipped = sum(p[2] for p in pruned)
+                if decoded or skipped:
+                    tables = [p[0] for p in pruned]
+                    self.chunks_decoded_total.inc(decoded)
+                    self.chunks_skipped_total.inc(skipped)
+                    self._last_chunk_prune = (decoded, skipped)
         total_rows = sum(int(t.nrows) for t in tables)
         # the same per-query cost estimate execute_local uses, worst shard
         # wins — a mismatched (optimistic) rate here would let slow-rated
@@ -1275,9 +1468,16 @@ class WorkerNode(WorkerBase):
         from bqueryd_tpu.parallel.opexec import DagExecutor
 
         executor = DagExecutor(self.engine)
+        self._last_chunk_prune = None
         payload = executor.execute(tables, dag, timer=timer)
         self._last_effective_strategy = executor.last_effective_strategy
         self._last_merge_mode = executor.last_merge_mode
+        decoded = sum(c[0] for c in executor._prune_counts)
+        skipped = sum(c[1] for c in executor._prune_counts)
+        if decoded or skipped:
+            self.chunks_decoded_total.inc(decoded)
+            self.chunks_skipped_total.inc(skipped)
+            self._last_chunk_prune = (decoded, skipped)
         return payload
 
     def _open_table(self, rootdir):
@@ -1303,6 +1503,8 @@ class WorkerNode(WorkerBase):
     def handle_work(self, msg):
         if msg.isa("execute_code"):
             return self.execute_code(msg)
+        if msg.isa("append"):
+            return self._append_rows(msg)
         if not msg.isa("groupby"):
             return super().handle_work(msg)
         if msg.get("bundle"):
@@ -1414,7 +1616,27 @@ class WorkerNode(WorkerBase):
         # a result-cache hit compiled nothing: "cached" keeps the reply's
         # route report honest instead of silently dropping the key
         effective = "cached" if data is not None else None
-        merge_mode = None  # only freshly computed queries merged anything
+        # delta-maintained serving: on a result-cache miss for a
+        # delta-eligible shape, try refreshing a cached result by
+        # aggregating ONLY the chunks appended since it was computed
+        # (ops.workingset; "delta" in the route report)
+        delta_cache = None
+        delta_key = None
+        if query is not None and self._delta_eligible(query):
+            delta_cache = self.delta_cache()
+            if delta_cache is not None:
+                delta_key = self._delta_key(tables, query)
+        if data is None and delta_cache is not None:
+            self._last_merge_mode = None
+            data = self._serve_delta(delta_cache, tables, query, timer)
+            if data is not None:
+                effective = "delta"
+                if cache is not None and len(data) <= cache.max_bytes // 8:
+                    cache.put(cache_key, data, nbytes=len(data))
+        merge_mode = (
+            getattr(self, "_last_merge_mode", None)
+            if effective == "delta" else None
+        )  # otherwise only freshly computed queries merged anything
         if data is None:
             import contextlib
 
@@ -1449,6 +1671,16 @@ class WorkerNode(WorkerBase):
                         span.setdefault("tags", {})[
                             "effective_strategy"
                         ] = effective
+            if recorder is not None and self._last_chunk_prune:
+                # zone-map pruning effect on the trace: the prune span
+                # says how many chunks the decode stages never touched
+                decoded_n, skipped_n = self._last_chunk_prune
+                for span in recorder.spans:
+                    if span.get("name") == "prune":
+                        tags = span.setdefault("tags", {})
+                        tags["chunks_decoded"] = decoded_n
+                        tags["chunks_skipped"] = skipped_n
+                        break
             # the execute above is proof the backend answered: safe to
             # (lazily) enumerate devices for HBM sampling from now on
             obs_profile.profiler().note_devices()
@@ -1476,6 +1708,11 @@ class WorkerNode(WorkerBase):
                 data = payload.to_bytes()
             if cache is not None and len(data) <= cache.max_bytes // 8:
                 cache.put(cache_key, data, nbytes=len(data))
+            if delta_cache is not None:
+                # record the delta base: the snapshots of the very table
+                # instances this result was computed from, so a later
+                # append refreshes it from the tail alone
+                delta_cache.store(delta_key, tables, data)
         if obs.enabled():
             # result-payload size per reply — observed for cache hits too,
             # so this histogram and its controller-side twin

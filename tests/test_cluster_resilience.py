@@ -2226,3 +2226,154 @@ def test_capacity_wedge_device_shrinks_fleet_mu_and_advises_scale_up(
     finally:
         chaos.disarm()
         _stop([controller] + workers, threads)
+
+
+def test_append_delta_failover_chaos(tmp_path, mem_store_url):
+    """PR-14 acceptance: append + kill-worker during a delta-refresh burst
+    leaves ZERO failed queries with results bit-exact vs a full recompute.
+
+    True replica topology (each worker owns its own data_dir copy of the
+    shard): rpc.append fans the batch to BOTH holders; a die_after_ack
+    chaos kill mid-burst fails the in-flight query over to the surviving
+    replica; post-cull appends route to the survivor alone and its repeat
+    queries keep being served by delta refreshes."""
+    import shutil
+
+    import numpy as np
+    import pandas as pd
+
+    from bqueryd_tpu import chaos
+    from bqueryd_tpu.controller import ControllerNode
+    from bqueryd_tpu.rpc import RPC
+    from bqueryd_tpu.storage.ctable import ctable
+    from bqueryd_tpu.worker import WorkerNode
+
+    rng = np.random.default_rng(77)
+
+    def batch(n, offset):
+        return pd.DataFrame(
+            {
+                "g": rng.integers(0, 4, n).astype(np.int64),
+                "v": rng.integers(-(2**40), 2**40, n).astype(np.int64),
+                "seq": np.arange(offset, offset + n, dtype=np.int64),
+            }
+        )
+
+    frame = batch(1200, 0)
+    dirs = [tmp_path / "a", tmp_path / "b"]
+    dirs[0].mkdir()
+    ctable.fromdataframe(
+        frame, str(dirs[0] / "t.bcolzs"), chunklen=256
+    )
+    shutil.copytree(str(dirs[0] / "t.bcolzs"), str(tmp_path / "b" / "t.bcolzs"))
+
+    controller = ControllerNode(
+        coordination_url=mem_store_url,
+        loglevel=logging.WARNING,
+        runfile_dir=str(tmp_path),
+        heartbeat_interval=0.05,
+        dead_worker_timeout=1.0,
+        dispatch_timeout=1.5,
+    )
+    workers = [
+        WorkerNode(
+            coordination_url=mem_store_url,
+            data_dir=str(d),
+            loglevel=logging.WARNING,
+            restart_check=False,
+            heartbeat_interval=0.2,
+            poll_timeout=0.05,
+        )
+        for d in dirs
+    ]
+    threads = _start(controller, *workers)
+    q = (["t.bcolzs"], ["g"], [["v", "sum", "s"]], [])
+
+    def expect(df):
+        return df.groupby("g")["v"].sum().to_dict()
+
+    try:
+        wait_until(
+            lambda: len(controller.files_map.get("t.bcolzs", ())) == 2,
+            desc="both replica holders advertising",
+        )
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=45,
+            loglevel=logging.WARNING,
+        )
+        got = rpc.groupby(*q)
+        assert dict(zip(got["g"], got["s"])) == expect(frame)
+
+        # append #1 lands on BOTH replicas
+        extra1 = batch(150, 1200)
+        res = rpc.append("t.bcolzs", extra1)
+        assert res["appended"] == 150 and len(res["holders"]) == 2
+        assert all(
+            ctable(str(d / "t.bcolzs")).nrows == 1350 for d in dirs
+        )
+        frame = pd.concat([frame, extra1], ignore_index=True)
+        got = rpc.groupby(*q)
+        assert dict(zip(got["g"], got["s"])) == expect(frame)
+        # (which holder serves each query is a scheduling choice, so the
+        # "delta" route is asserted deterministically below, once a single
+        # survivor serves everything)
+
+        # kill one holder mid-burst: the in-flight query fails over
+        chaos.arm({
+            "seed": 5,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        extra2 = batch(150, 1350)
+        # the dying side may or may not have applied extra2 before the
+        # kill fires on the next groupby — the SURVIVOR's state is what
+        # queries answer from, so append first, then query through chaos
+        failed = 0
+        try:
+            rpc.append("t.bcolzs", extra2, deadline=20)
+        except Exception:
+            # a holder that died mid-append reports a structured error;
+            # the surviving replica applied it (asserted via parity below)
+            pass
+        frame = pd.concat([frame, extra2], ignore_index=True)
+        try:
+            got = rpc.groupby(*q)
+        except Exception:
+            failed += 1
+        assert failed == 0, "chaos burst must leave zero failed queries"
+        assert dict(zip(got["g"], got["s"])) == expect(frame)
+        assert chaos.injected_total() >= 1
+        chaos.disarm()
+
+        wait_until(
+            lambda: len(controller.worker_map) == 1,
+            desc="dead worker culled",
+        )
+        survivor = [
+            w for w in workers
+            if w.worker_id in controller.worker_map
+        ][0]
+
+        # the survivor serves everything now: establish its delta base,
+        # append (routes to it alone), and the repeat MUST delta-refresh
+        got = rpc.groupby(*q)
+        assert dict(zip(got["g"], got["s"])) == expect(frame)
+        extra3 = batch(100, 1500)
+        res = rpc.append("t.bcolzs", extra3)
+        assert len(res["holders"]) == 1
+        frame = pd.concat([frame, extra3], ignore_index=True)
+        refreshes_before = survivor.delta_refreshes_total.value
+        got = rpc.groupby(*q)
+        assert dict(zip(got["g"], got["s"])) == expect(frame)
+        assert survivor.delta_refreshes_total.value > refreshes_before
+        assert (
+            rpc.last_call_strategies["effective"]["t.bcolzs"] == "delta"
+        )
+        assert controller.counters["failover_dispatches"] >= 1
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)

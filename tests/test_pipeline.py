@@ -322,6 +322,44 @@ def test_meta_mtime_bump_invalidates_working_set(ws_tables):
     assert stats1["align"]["entries"] == 2  # old + new identity
 
 
+def test_append_invalidates_working_set_and_serves_new_rows(ws_tables):
+    """PR-14 satellite: the append path must invalidate like activation —
+    content keys carry the table's row count + meta identity, and the
+    decoded-column cache keys carry the committed chunk/row counts, so a
+    grown shard can never serve stale cached bytes or stale aggregates."""
+    frames, tables = ws_tables
+    ex = MeshQueryExecutor(mesh=make_mesh())
+    query = GroupByQuery(["g"], [["v", "sum", "s"]])
+    before = ex.execute(tables, query)
+    stats0 = ex.workingset.stats()
+
+    extra = pd.DataFrame(
+        {
+            "g": np.array([0, 1], dtype=np.int64),
+            "h": np.array([0, 1], dtype=np.int64),
+            "v": np.array([10_000_000, -10_000_000], dtype=np.int64),
+            "w": np.array([1, 2], dtype=np.int64),
+        }
+    )
+    ctable(tables[0].rootdir, mode="a").append_dataframe(extra)
+    grown = [ctable(t.rootdir) for t in tables]
+    after = ex.execute(grown, query)
+    stats1 = ex.workingset.stats()
+    assert stats1["align"]["misses"] == stats0["align"]["misses"] + 1
+    assert stats1["codes"]["misses"] == stats0["codes"]["misses"] + 1
+    # the appended rows are IN the answer (no stale decode anywhere)
+    def total(payload):
+        return dict(
+            zip(
+                payload["keys"]["g"].tolist(),
+                payload["aggs"][0]["sum"].tolist(),
+            )
+        )
+    t0, t1 = total(before), total(after)
+    assert t1[0] == t0[0] + 10_000_000
+    assert t1[1] == t0[1] - 10_000_000
+
+
 def test_column_set_change_misses(ws_tables, monkeypatch):
     """A different groupby column set is a different content key: align and
     codes must miss (and factorize the new key column)."""
