@@ -183,6 +183,16 @@ ENV_REGISTRY = {
            "default relative accuracy of DAG quantile sketches "
            "(DDSketch-style log buckets; estimate error <= alpha)",
            related=("TOPK_LIMIT", "JOIN_BROADCAST_LIMIT")),
+        _v("DAG_BATCH", "flag", "1",
+           "batched shard-group dispatch + device-resident merge for "
+           "extended DAG queries (0 = PR-13 per-shard dispatch + host "
+           "merge, bit-identical; the mixed-version fallback)",
+           related=("DEVICE_MERGE", "SKETCH_GRID_CELLS")),
+        _v("SKETCH_GRID_CELLS", "int", "2^23",
+           "dense sketch-grid cell budget (padded groups x bucket width) "
+           "for the DAG fast path's device merge; above it quantile "
+           "queries fall back to the per-shard host merge",
+           related=("DAG_BATCH", "SKETCH_ALPHA")),
         _v("DOWNLOAD_THREADS", "int", "3",
            "parallel blob fetches per downloader"),
         _v("INCOMING", "path", "data_dir/incoming",

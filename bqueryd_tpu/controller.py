@@ -3825,22 +3825,33 @@ class ControllerNode:
         ONE CalcMessage so the worker merges them on its device mesh with a
         psum instead of the controller collecting N serialized partials —
         the core TPU redesign of the reference's per-shard fan-out
-        (reference bqueryd/controller.py:494-506).  Batching applies only to
-        psum-mergeable aggregations; distinct-count and raw-rows queries
-        keep per-shard dispatch.  ``batch=False`` forces the reference's
-        one-message-per-shard behaviour (finer retry granularity).
+        (reference bqueryd/controller.py:494-506).  Batching applies to
+        device-mergeable part kinds: the psum-mergeable classic ops, plus —
+        for DAG dispatches (``kwargs["dag"]``, whose ``batch`` flag
+        ``plan.dag.groupby_equivalent`` already gates on the part kinds and
+        the ``BQUERYD_TPU_DAG_BATCH`` kill switch) — the extended top-k /
+        quantile-sketch ops the worker's mesh fast path merges on device.
+        Distinct-count and raw-rows queries keep per-shard dispatch.
+        ``batch=False`` forces the reference's one-message-per-shard
+        behaviour (finer retry granularity).
         """
         from bqueryd_tpu.models.query import MERGEABLE_OPS, GroupByQuery
+        from bqueryd_tpu.plan.dag import is_extended_op
 
         probe = GroupByQuery(
             groupby_cols, agg_list, aggregate=kwargs.get("aggregate", True)
         )
         from bqueryd_tpu.parallel import devicemerge
 
+        dag_riding = kwargs.get("dag") is not None
         batchable = (
             kwargs.get("batch", True)
             and probe.aggregate
-            and all(op in MERGEABLE_OPS for op in probe.ops)
+            and all(
+                op in MERGEABLE_OPS
+                or (dag_riding and is_extended_op(op))
+                for op in probe.ops
+            )
             # BQUERYD_TPU_DEVICE_MERGE=0: the merge stays host-side end to
             # end — per-shard dispatch so every shard's partial table rides
             # the wire and merges via hostmerge (the measurable host-gather

@@ -123,9 +123,11 @@ ENVELOPE_SCHEMA = {
                           "re-aggregated, merged into the cached result) — "
                           "hints may normalize",
     "merge_mode": "how the reply's partials merged: 'device' (ICI-mesh "
-                  "collective, final table only fetched), 'host' "
-                  "(hostmerge.merge_payloads fallback), 'none' (single "
-                  "payload, nothing merged)",
+                  "collective, final table only fetched — classic groupbys "
+                  "since PR 7, batched extended-DAG dispatches since "
+                  "PR 15), 'host' (hostmerge.merge_payloads fallback, also "
+                  "the per-shard DAG pipeline's cross-shard merge), 'none' "
+                  "(single payload, nothing merged)",
     "bundle_members": "on shared-scan bundle replies: the member_id list "
                       "the reply's data frame covers (its bytes are one "
                       "pickled {payloads: {member_id: bytes}, errors: "

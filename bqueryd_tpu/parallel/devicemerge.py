@@ -122,6 +122,66 @@ def scatter_merge_partials(partials, axis_name, n_devices, span):
     return {"rows": rows, "aggs": aggs}
 
 
+def allgather_topk_merge(values, counts, axis_name, span, largest,
+                         float_neg):
+    """Cross-device merge of dense per-group top-k partials INSIDE the mesh
+    program: all-gather the ``[padded_groups, k]`` dense tables + per-group
+    counts, re-select the best ``k`` per group over the ``n_dev * k``
+    candidates, and keep this device's own key span — ``[span, k]`` +
+    ``[span]`` outputs, so (like the reduce-scattered classic partials)
+    only final-table bytes ever leave HBM.
+
+    The re-select is MULTISET-equal to the host k-way merge
+    (``opexec.merge_topk_parts``): top-k payloads carry VALUES only, so
+    which of several equal-valued candidates survives is unobservable.
+    Validity rides a lexsort primary key (a dense slot is live iff its
+    rank < its device's count), which is what lets the gathered zero-pad
+    slots never shadow a real candidate.  ``span=None`` (the multi-host
+    psum contract) skips the own-span slice and returns the full
+    replicated merged table."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    k = int(values.shape[1])
+    gathered = lax.all_gather(values, axis_name)     # [n_dev, G, k]
+    gcounts = lax.all_gather(counts, axis_name)      # [n_dev, G]
+    n_dev = int(gathered.shape[0])
+    n_groups = int(gathered.shape[1])
+    rank = jnp.arange(k, dtype=jnp.int64)
+    valid = rank[None, None, :] < gcounts[:, :, None]
+    cand = jnp.moveaxis(gathered, 0, 1).reshape(n_groups, n_dev * k)
+    vmask = jnp.moveaxis(valid, 0, 1).reshape(n_groups, n_dev * k)
+    if largest:
+        sort_v = -cand if float_neg else ~cand
+    else:
+        sort_v = cand
+    # primary key: validity (valid first); secondary: best-first value
+    order = jnp.lexsort((sort_v, ~vmask), axis=-1)
+    top = jnp.take_along_axis(cand, order[:, :k], axis=-1)
+    cnt = jnp.minimum(gcounts.sum(axis=0), k)
+    if span is None:
+        return top, cnt
+    start = lax.axis_index(axis_name) * span
+    zero = jnp.zeros((), dtype=start.dtype)
+    return (
+        lax.dynamic_slice(top, (start, zero), (span, k)),
+        lax.dynamic_slice(cnt, (start,), (span,)),
+    )
+
+
+def scatter_merge_grid(grid, axis_name, span):
+    """Bucket-count ADDITION of dense per-(group, bucket) sketch grids
+    across the mesh axis: one reduce-scatter over the padded group axis
+    (span ownership, the :func:`scatter_merge_partials` contract) —
+    ``[span, width]`` out per device.  ``span=None`` (multi-host) psums to
+    the replicated full grid instead."""
+    from jax import lax
+
+    if span is None:
+        return lax.psum(grid, axis_name)
+    return lax.psum_scatter(grid, axis_name, scatter_dimension=0, tiled=True)
+
+
 class MergeStats:
     """Process-wide merge byte-movement accounting (thread-safe): D2H bytes
     fetched per merge mode, queries per mode, and the per-device partial

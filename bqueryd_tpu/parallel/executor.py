@@ -200,6 +200,10 @@ class MeshQueryExecutor:
         #: ("device" | "host") — the worker surfaces it as the reply
         #: envelope's ``merge_mode`` key
         self.last_merge_mode = None
+        #: per-shard (decoded, skipped) chunk-prune counts of the last
+        #: execute_dag() — the worker folds the totals into its chunk
+        #: counters, mirroring opexec.DagExecutor._prune_counts
+        self.last_prune_counts = []
         from bqueryd_tpu.ops.workingset import WorkingSet
 
         # the device-resident working-set layer (ops/workingset.py): LRU
@@ -1181,6 +1185,575 @@ class MeshQueryExecutor:
                 )
             return out
 
+    # -- operator-DAG fast path ----------------------------------------------
+    def execute_dag(self, tables, dag):
+        """Batched mesh execution of an EXTENDED operator DAG (joins /
+        top-k / quantile sketches / window rollups): one decode/align/H2D
+        pass over the whole shard group — join-probe gathers, window-bucket
+        derived keys and the folded composite codes all land in the same
+        content-keyed working-set segments the classic path uses — one
+        compiled mesh program emitting every aggregation's partial state,
+        and the PR-7 span-owned device-resident merge: classic GroupAgg
+        partials and sketch bucket grids reduce-scatter (associative
+        bucket-count addition), top-k dense tables all-gather + re-select
+        on device, so only the final merged table leaves HBM.  Returns ONE
+        :class:`ResultPayload` for the whole group (``merge_mode``
+        "device").
+
+        Raises :class:`DagFastPathUnsupported` for shapes the mesh cannot
+        merge (count_distinct sets, raw rows, object-dtype derived
+        measures, an over-budget sketch grid, composite overflow, the
+        ``BQUERYD_TPU_DEVICE_MERGE=0`` kill switch): the worker then falls
+        back to the PR-13 per-shard pipeline + host value-keyed merge.
+        Parity vs that fallback: integer aggregates, top-k value multisets
+        and sketch buckets are bit-identical; float sums/means differ only
+        by reassociation (the same tolerance class as every kernel route
+        choice); query-shape validation errors (:class:`DagValidationError`,
+        datetime sums) raise identically on both routes."""
+        from bqueryd_tpu import chaos, ops
+        from bqueryd_tpu.models.query import (
+            MERGEABLE_OPS,
+            ResultPayload,
+        )
+        from bqueryd_tpu.parallel import devicemerge, opexec, pipeline
+        from bqueryd_tpu.plan.dag import DagValidationError, parse_op
+
+        if chaos.enabled():
+            chaos.fire(
+                "worker.device",
+                n_tables=len(tables),
+                signature=f"dag:{str(dag.signature())[:100]}",
+            )
+        self.last_effective_strategy = None
+        self.last_merge_mode = None
+        self.last_prune_counts = []
+        merge_mode = devicemerge.resolve_mode()
+        if merge_mode == devicemerge.MODE_HOST:
+            raise DagFastPathUnsupported(
+                "BQUERYD_TPU_DEVICE_MERGE=0: merge stays host-side"
+            )
+        if not dag.aggregate_rows:
+            raise DagFastPathUnsupported("raw-rows DAGs dispatch per shard")
+        parsed = [parse_op(a[1]) for a in dag.aggs]
+        classic_idx, topk_idx, sketch_idx = [], [], []
+        for i, p in enumerate(parsed):
+            if p[0] in MERGEABLE_OPS:
+                classic_idx.append(i)
+            elif p[0] == "topk":
+                topk_idx.append(i)
+            elif p[0] == "quantile":
+                sketch_idx.append(i)
+            else:
+                raise DagFastPathUnsupported(
+                    f"op {dag.aggs[i][1]!r} has no device-mergeable partial"
+                )
+
+        with self._phase("prune"):
+            if dag.scan.pushdown:
+                tables = [
+                    t for t in tables
+                    if ops.shard_can_match(t, dag.scan.pushdown)
+                ]
+                pruned = []
+                for t in tables:
+                    view, decoded, skipped = ops.chunk_pruned_table(
+                        t, dag.scan.pushdown
+                    )
+                    pruned.append(view)
+                    if decoded or skipped:
+                        self.last_prune_counts.append((decoded, skipped))
+                tables = pruned
+        if not tables:
+            return ResultPayload.empty()
+
+        first = tables[0]
+
+        def col_source(col):
+            if dag.window is not None and col == dag.window.alias:
+                return "window"
+            if dag.join is not None and col in dag.join.select:
+                return "join"
+            if col not in first:
+                raise DagValidationError(
+                    f"column {col!r} is not a fact column, a join-selected "
+                    f"column, or the window alias"
+                )
+            return "fact"
+
+        from bqueryd_tpu.parallel.opexec import NAT_SENTINEL
+
+        unique_cols = list(dict.fromkeys(a[0] for a in dag.aggs))
+        kind_of, sentinel_of = {}, {}
+        for col in unique_cols:
+            src = col_source(col)
+            if src == "window":
+                kind_of[col], sentinel_of[col] = "datetime", NAT_SENTINEL
+            elif src == "join":
+                dimv = np.asarray(dag.join.table[col])
+                if dimv.dtype == object:
+                    raise DagFastPathUnsupported(
+                        f"object-dtype join measure {col!r}"
+                    )
+                # the ONE shared copy of the dim-measure dtype rules
+                # (opexec.dim_measure_kind): leg parity depends on it
+                sentinel_of[col], kind_of[col] = opexec.dim_measure_kind(
+                    dimv.dtype
+                )
+            else:
+                kind_of[col] = _measure_kind(tables, col)
+                sentinel_of[col] = (
+                    NAT_SENTINEL if kind_of[col] == "datetime" else None
+                )
+        # query-shape validation, identical (message and class) to the
+        # per-shard route so the fast path never masks or changes an error
+        for i, (in_col, op, _out) in enumerate(dag.aggs):
+            kind = parsed[i][0]
+            if kind in ("sum", "mean") and kind_of[in_col] == "datetime":
+                raise ValueError(
+                    f"{kind!r} is not defined for datetime column {in_col!r}"
+                )
+            src = col_source(in_col)
+            is_dict = src == "fact" and first.kind(in_col) == "dict"
+            if kind == "topk" and is_dict:
+                raise DagValidationError(
+                    f"topk measure {in_col!r} must be numeric or "
+                    f"datetime, not strings"
+                )
+            if kind == "quantile" and (
+                is_dict or sentinel_of[in_col] is not None
+            ):
+                raise DagValidationError(
+                    f"quantile measure {in_col!r} must be numeric "
+                    f"(strings/datetimes have no sketch ordering)"
+                )
+
+        engine = self._engine()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tables_key = tuple(_table_key(t) for t in tables)
+        derive_sig = dag.derive_signature()
+        mesh = self.mesh
+        n_dev = mesh.devices.size
+        self.last_merge_mode = "device"
+        sharding = NamedSharding(mesh, P(self.axis_name, None))
+
+        # per-shard derivations (join probe / window buckets / per-key
+        # codes) — the EXACT per-shard host code of the fallback route
+        # (opexec.DagExecutor), content-keyed in the align segment so a
+        # repeat query (same derivations, any measures) skips them all
+        dexec = opexec.DagExecutor(engine)
+
+        def derive(table):
+            dkey = (_table_key(table), "dagderive", derive_sig)
+            hit = self._align_cache.get(dkey)
+            if hit is not None:
+                return hit
+            state = opexec._ShardState(table, dag)
+            mask = ops.build_mask(table, dag.scan.pushdown)
+            mask = None if mask is None else np.asarray(mask, dtype=bool)
+            if dag.join is not None:
+                mask = dexec._probe_join(state, mask)
+            if dag.window is not None:
+                dexec._derive_window(state)
+            if dag.filter is not None and dag.filter.terms:
+                for col, fop, value in dag.filter.terms:
+                    m = opexec._eval_post_term(
+                        dexec._post_filter_values(state, col), fop, value
+                    )
+                    mask = m if mask is None else (mask & m)
+            per_key = [
+                dexec._key_codes_for(state, c) for c in dag.group_keys
+            ]
+            entry = (mask, per_key, state.row_pos, state.window_ints)
+            nbytes = sum(
+                np.asarray(c).nbytes + np.asarray(v).nbytes
+                for c, v in per_key
+            )
+            for extra in (mask, state.row_pos, state.window_ints):
+                if extra is not None:
+                    nbytes += np.asarray(extra).nbytes
+            self._align_cache.put(dkey, entry, nbytes=nbytes)
+            return entry
+
+        derived_memo = {}
+
+        def get_derived():
+            if "v" not in derived_memo:
+                derived_memo["v"] = self._map_shards(derive, tables)
+            return derived_memo["v"]
+
+        missing_cols = [
+            col for col in unique_cols
+            if (
+                (tables_key, "col", col, n_dev) not in self._hbm_cache
+                if col_source(col) == "fact"
+                else (tables_key, "dagcol", col, derive_sig, n_dev)
+                not in self._hbm_cache
+            )
+        ]
+        codes_key = (tables_key, "dagcodes", derive_sig, n_dev)
+        codes_warm = codes_key in self._codes_cache
+        if missing_cols or not codes_warm:
+            self.workingset.evict_under_pressure()
+
+        with self._phase("align"), pipeline.stage("align"):
+            akey = (tables_key, "dagalign", derive_sig)
+            cached = self._align_cache.get(akey)
+            if cached is None:
+                dense, combo_cols, key_values = self._dag_key_space(
+                    get_derived(), dag
+                )
+                self._align_cache.put(
+                    akey, (dense, combo_cols, key_values),
+                    nbytes=sum(d.nbytes for d in dense)
+                    + combo_cols.nbytes
+                    + sum(
+                        np.asarray(v).nbytes for v in key_values.values()
+                    ),
+                )
+            else:
+                dense, combo_cols, key_values = cached
+            n_groups = max(len(combo_cols), 1)
+
+        # sketch-grid budget BEFORE any upload: the device merge
+        # materializes one dense [padded_groups, width] int64 grid per
+        # sketch agg per device — past the cell budget the flat host merge
+        # is the better economics and the whole query falls back
+        n_prog = ops.program_bucket(n_groups)
+        span, padded = devicemerge.bucket_span(n_prog, int(n_dev))
+        sketch_geo = {}
+        for i in sketch_idx:
+            alpha = parsed[i][2]
+            width, kmin = opexec.sketch_grid_layout(alpha)
+            if padded * width > sketch_grid_cells_limit():
+                raise DagFastPathUnsupported(
+                    f"sketch grid {padded}x{width} cells exceeds "
+                    f"BQUERYD_TPU_SKETCH_GRID_CELLS"
+                )
+            sketch_geo[i] = (width, kmin)
+
+        codes_d = self._codes_cache.get(codes_key)
+        if codes_d is None:
+            with self._phase("layout"):
+                with pipeline.stage("align"):
+                    cdt = _codes_dtype(n_groups)
+                    packed = self._pack(
+                        [d.astype(cdt) for d in dense], n_dev,
+                        cdt.type(-1), dtype=cdt,
+                    )
+                with pipeline.stage("h2d"):
+                    codes_d = _put(packed, sharding)
+                self._codes_cache.put(codes_key, codes_d)
+
+        with self._phase("layout"):
+            measures_d, slot_of = [], {}
+            for col in unique_cols:
+                if col_source(col) == "fact":
+                    mkey = (tables_key, "col", col, n_dev)
+                    arr = self._hbm_cache.get(mkey)
+                    if arr is None:
+                        with pipeline.stage("decode"):
+                            wire = (
+                                _wire_dtype(tables, col)
+                                or _stored_dtype(tables, col)
+                            )
+                            cols = [
+                                np.asarray(t.column_raw(col))
+                                for t in tables
+                            ]
+                            if wire is not None:
+                                cols = [
+                                    c.astype(wire, copy=False)
+                                    for c in cols
+                                ]
+                            packed = self._pack(cols, n_dev, 0, dtype=wire)
+                        with pipeline.stage("h2d"):
+                            arr = _put(packed, sharding)
+                        self._hbm_cache.put(mkey, arr)
+                else:
+                    mkey = (tables_key, "dagcol", col, derive_sig, n_dev)
+                    arr = self._hbm_cache.get(mkey)
+                    if arr is None:
+                        with pipeline.stage("decode"):
+                            vals = []
+                            for entry in get_derived():
+                                _m, _pk, row_pos, window_ints = entry
+                                if col_source(col) == "window":
+                                    vals.append(np.asarray(window_ints))
+                                else:
+                                    vals.append(
+                                        opexec.gathered_dim_values(
+                                            dag.join.table[col], row_pos
+                                        )
+                                    )
+                            packed = self._pack(vals, n_dev, 0)
+                        with pipeline.stage("h2d"):
+                            arr = _put(packed, sharding)
+                        self._hbm_cache.put(mkey, arr)
+                slot_of[col] = len(measures_d)
+                measures_d.append(arr)
+
+        classic_spec = tuple(
+            (
+                slot_of[dag.aggs[i][0]],
+                parsed[i][0],
+                sentinel_of[dag.aggs[i][0]],
+            )
+            for i in classic_idx
+        )
+        topk_spec = []
+        for i in topk_idx:
+            col = dag.aggs[i][0]
+            dt = np.dtype(measures_d[slot_of[col]].dtype)
+            if dt == object:
+                raise DagFastPathUnsupported(
+                    f"object-dtype topk measure {col!r}"
+                )
+            is_float = np.issubdtype(dt, np.floating)
+            topk_spec.append(
+                (
+                    slot_of[col], parsed[i][1], parsed[i][2],
+                    is_float,
+                    None if sentinel_of[col] is None
+                    else int(sentinel_of[col]),
+                    is_float,
+                )
+            )
+        topk_spec = tuple(topk_spec)
+        sketch_spec = []
+        for i in sketch_idx:
+            col = dag.aggs[i][0]
+            alpha = parsed[i][2]
+            _gamma, lg, imin, imax = opexec.sketch_layout(alpha)
+            width, kmin = sketch_geo[i]
+            sketch_spec.append(
+                (slot_of[col], float(lg), int(imin), int(imax),
+                 int(kmin), int(width))
+            )
+        sketch_spec = tuple(sketch_spec)
+
+        with self._phase("aggregate"), pipeline.stage("kernel"):
+            per_classic_d = tuple(
+                measures_d[s] for s, _op, _st in classic_spec
+            )
+            self.last_effective_strategy = ops.kernel_route(
+                None, per_classic_d,
+                tuple(op for _s, op, _st in classic_spec),
+                int(codes_d.shape[1]), n_prog,
+            )
+            merged = _mesh_dag_partials(
+                mesh, self.axis_name, n_prog, codes_d, tuple(measures_d),
+                classic_spec, topk_spec, sketch_spec,
+                merge_mode=merge_mode, timer=self.timer,
+            )
+            if n_prog != n_groups:
+                merged = jax.tree_util.tree_map(
+                    lambda a: a[:n_groups], merged
+                )
+
+        with self._phase("collect"), pipeline.stage("merge"):
+            rows = np.asarray(merged["classic"]["rows"])
+            present = rows > 0
+            present_idx = np.flatnonzero(present)
+            keys = {}
+            for ci, col in enumerate(dag.group_keys):
+                vals = np.asarray(key_values[col])
+                keys[col] = vals[combo_cols[present_idx, ci]]
+            aggs_out = [None] * len(dag.aggs)
+            for pos, i in enumerate(classic_idx):
+                in_col = dag.aggs[i][0]
+                stored = (
+                    _stored_dtype(tables, in_col)
+                    if col_source(in_col) == "fact" else None
+                )
+                sel = {}
+                for kname, v in dict(
+                    merged["classic"]["aggs"][pos]
+                ).items():
+                    v = np.asarray(v)[present]
+                    if (
+                        kname in ("min", "max")
+                        and stored is not None
+                        and v.dtype != stored
+                        and stored.kind in "iu"
+                    ):
+                        v = v.astype(stored)
+                    sel[kname] = v
+                aggs_out[i] = sel
+            for pos, i in enumerate(topk_idx):
+                in_col = dag.aggs[i][0]
+                top, cnt = merged["topk"][pos]
+                top = np.asarray(top)[present_idx]
+                cnt = np.asarray(cnt)[present_idx]
+                stored = (
+                    _stored_dtype(tables, in_col)
+                    if col_source(in_col) == "fact" else None
+                )
+                if (
+                    stored is not None
+                    and top.dtype != stored
+                    and stored.kind in "iu"
+                ):
+                    top = top.astype(stored)
+                flat, offsets = opexec.dense_topk_to_flat(top, cnt)
+                aggs_out[i] = {
+                    "topk_values": flat, "topk_offsets": offsets
+                }
+            for pos, i in enumerate(sketch_idx):
+                grid = np.asarray(merged["sketch"][pos])[present_idx]
+                _width, kmin = sketch_geo[i]
+                skeys, scounts, soffs = opexec.sketch_grid_to_flat(
+                    grid, kmin
+                )
+                aggs_out[i] = {
+                    "sketch_keys": skeys,
+                    "sketch_counts": scounts,
+                    "sketch_offsets": soffs,
+                }
+            value_kinds = [
+                None if parsed[i][0] == "quantile"
+                else kind_of[dag.aggs[i][0]]
+                for i in range(len(dag.aggs))
+            ]
+            return ResultPayload.partials(
+                key_cols=list(dag.group_keys),
+                keys=keys,
+                rows=rows[present],
+                aggs=aggs_out,
+                ops=[a[1] for a in dag.aggs],
+                out_cols=[a[2] for a in dag.aggs],
+                value_kinds=value_kinds,
+            )
+
+    def _dag_key_space(self, derived, dag):
+        """Global composite key space over the DAG's (possibly derived)
+        group keys — the DAG twin of :meth:`_global_key_space`, fed by the
+        cached per-shard derivations instead of ``engine._key_codes``.
+        The pushdown / join-miss / post-derivation-filter mask is folded
+        INTO the dense codes here (the derivation signature keys the cache
+        entry, so a different filter is a different entry): masked rows
+        carry code -1 and vanish from every reduction, exactly like the
+        classic folded codes.  Returns ``(folded dense codes per shard,
+        combo_cols [n_combos, n_cols] global dictionary positions,
+        key_values)`` with combos in sorted composite order."""
+        from bqueryd_tpu import ops
+
+        n_cols = len(dag.group_keys)
+        n_shards = len(derived)
+        masks = [d[0] for d in derived]
+        shard_codes = [
+            [np.asarray(d[1][ci][0]) for d in derived]
+            for ci in range(n_cols)
+        ]
+        shard_values = [
+            [np.asarray(d[1][ci][1]) for d in derived]
+            for ci in range(n_cols)
+        ]
+        cards, global_values = [], []
+        pos_maps = [[] for _ in range(n_cols)]
+        for ci in range(n_cols):
+            gvals = np.unique(np.concatenate(shard_values[ci]))
+            # null VALUES (NaN/NaT) strip from the global dictionary: the
+            # rows referencing them already carry poisoned codes (-1) —
+            # same rule as the classic alignment
+            if gvals.dtype.kind == "f":
+                gvals = gvals[~np.isnan(gvals)]
+            elif gvals.dtype.kind == "M":
+                gvals = gvals[~np.isnat(gvals)]
+            cards.append(max(len(gvals), 1))
+            global_values.append(gvals)
+            for si in range(n_shards):
+                pos_maps[ci].append(
+                    np.searchsorted(gvals, shard_values[ci][si])
+                )
+
+        def mapped(si, ci):
+            codes = shard_codes[ci][si]
+            pos = pos_maps[ci][si]
+            if len(pos) == 0:
+                return np.full(len(codes), np.int64(-1))
+            return np.where(
+                codes >= 0, pos[np.clip(codes, 0, None)], np.int64(-1)
+            )
+
+        def fold(si, dense_si):
+            m = masks[si]
+            if m is None:
+                return dense_si
+            return np.where(m, dense_si, np.int64(-1))
+
+        key_values = dict(zip(dag.group_keys, global_values))
+        if n_cols == 1:
+            dense = self._map_shards(
+                lambda si: fold(si, mapped(si, 0).astype(np.int64)),
+                range(n_shards),
+            )
+            combo_cols = np.arange(
+                len(global_values[0]), dtype=np.int64
+            )[:, None]
+            return dense, combo_cols, key_values
+
+        if ops.total_cardinality(cards) >= ops.MAX_COMPOSITE:
+            raise ops.CompositeOverflow(
+                "composite group-key space "
+                f"{'x'.join(str(int(c)) for c in cards)} exceeds int64"
+            )
+
+        def shard_composites(si):
+            packed = np.asarray(
+                ops.pack_codes(
+                    [mapped(si, ci) for ci in range(n_cols)], cards
+                )
+            )
+            m = masks[si]
+            if m is not None:
+                packed = np.where(m, packed, np.int64(-1))
+            inv, uniq = ops.factorize(packed)
+            return np.asarray(inv), np.asarray(uniq, dtype=np.int64)
+
+        composites = self._map_shards(shard_composites, range(n_shards))
+        observed = [u[u >= 0] for _inv, u in composites]
+        observed = [o for o in observed if len(o)]
+        combos = (
+            np.unique(np.concatenate(observed))
+            if observed
+            else np.empty(0, dtype=np.int64)
+        )
+        dense = []
+        for inv, uniq in composites:
+            lut = np.searchsorted(
+                combos, np.clip(uniq, 0, None)
+            ).astype(np.int64)
+            lut[uniq < 0] = -1
+            dense.append(lut[inv])
+        combo_cols = (
+            np.stack(ops.unpack_codes(combos, cards), axis=1)
+            if len(combos)
+            else np.empty((0, n_cols), dtype=np.int64)
+        )
+        return dense, combo_cols, key_values
+
+
+class DagFastPathUnsupported(Exception):
+    """The mesh fast path cannot serve this extended-DAG dispatch (shape,
+    dtype, budget, or the device-merge kill switch).  NOT an error the
+    client ever sees: the worker catches it and falls back to the PR-13
+    per-shard operator pipeline + host value-keyed merge, which serves
+    every DAG shape."""
+
+
+def sketch_grid_cells_limit():
+    """Cell budget (padded groups x bucket width) above which a quantile
+    sketch keeps the per-shard host path: the device merge materializes one
+    dense int64 ``[groups, width]`` grid per sketch agg per device, and
+    past this budget (default 2^23 cells = 64 MiB of HBM + ICI per agg)
+    the flat host merge it replaces is the better economics.  Tune with
+    BQUERYD_TPU_SKETCH_GRID_CELLS."""
+    return int(
+        os.environ.get("BQUERYD_TPU_SKETCH_GRID_CELLS", str(1 << 23))
+    )
+
 
 def _pack_leaf(leaf):
     """Bitcast any result leaf to its native bytes (lossless, no widening —
@@ -1440,6 +2013,99 @@ def _mesh_bundle_program(mesh, axis, n_groups, in_dtypes, in_width, pack,
     ), spec
 
 
+def _fetch_merged(run, call, merge_mode, n_dev, finish, timer, latch, what):
+    """The ONE packed-fetch scaffold shared by the three mesh fetch paths
+    (:func:`_mesh_partials`, :func:`_mesh_bundle_partials`,
+    :func:`_mesh_dag_partials`): run the packed program and fetch one byte
+    buffer, falling back to the per-leaf ``device_get`` of the unpacked
+    program when the packed one fails.
+
+    ``latch`` is the per-path policy after a DETERMINISTIC packed failure:
+    ``True`` (the solo and DAG paths) counts consecutive transient-classed
+    failures against ``_PACKED_TRANSIENT_LIMIT`` (a deterministic XLA bug
+    misclassed INTERNAL cannot dodge the latch forever) and commits
+    ``_packed_fetch_broken`` once per-leaf succeeds — per-leaf working
+    where packed failed is the actual evidence against packing; ``False``
+    (bundles) propagates transients unconditionally and never latches, the
+    solo path owning the packed-broken diagnosis.  ``run(pack_flag)``
+    returns ``(program, spec)``; ``call(program)`` invokes it with the
+    caller's argument tuple; ``finish(merged, fetched_bytes)`` is the
+    caller's layout normalization + merge-byte accounting."""
+    global _packed_fetch_broken, _packed_transient_count
+    import jax
+
+    from bqueryd_tpu.parallel import devicemerge
+
+    pack = packed_fetch_enabled() and not _packed_fetch_broken
+    latch_pending = False
+    if pack:
+        try:
+            program, spec = run(True)
+            with _collective_guard():
+                out = call(program)
+                _block_ready(out)
+                with _fetch_phase(timer):
+                    flat = np.asarray(jax.device_get(out))
+        except Exception as exc:
+            transient = isinstance(
+                exc, jax.errors.JaxRuntimeError
+            ) and _transient_status(exc)
+            if transient and (
+                not latch
+                or _packed_transient_count + 1 < _PACKED_TRANSIENT_LIMIT
+            ):
+                # transient infrastructure fault (flaky remote-compile
+                # HTTP 500s as INTERNAL, dropped links as UNAVAILABLE):
+                # NOT evidence against packing — propagate so the caller's
+                # retry / the worker's degrade+failover machinery decides
+                # instead of re-executing the whole program per-leaf on
+                # the same flaky backend
+                if latch:
+                    _packed_transient_count += 1
+                raise
+            if latch:
+                # deterministic packed failure: per-leaf retry below, and
+                # the process latches off packed fetch once it succeeds
+                latch_pending = True
+            import logging
+
+            logging.getLogger("bqueryd_tpu").exception(
+                "packed %s fetch failed; retrying via per-leaf "
+                "device_get", what,
+            )
+        else:
+            if latch:
+                _packed_transient_count = 0
+            if merge_mode == devicemerge.MODE_PSUM:
+                merged = jax.tree_util.tree_unflatten(
+                    spec["treedef"], _unpack_host(flat, spec["leaves"])
+                )
+            else:
+                merged = _assemble_sharded(flat, spec, n_dev, merge_mode)
+            return finish(merged, flat.nbytes)
+    program, _spec = run(False)
+    with _collective_guard():
+        out = call(program)
+        _block_ready(out)
+        with _fetch_phase(timer):
+            result = jax.device_get(out)
+    if latch_pending:
+        _packed_fetch_broken = True
+        _packed_transient_count = 0
+        import logging
+
+        logging.getLogger("bqueryd_tpu").warning(
+            "packed fetch unavailable on this backend (per-leaf fetch "
+            "succeeded where the packed %s program failed); using "
+            "per-leaf device_get for the process lifetime", what,
+        )
+    fetched = sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(result)
+    )
+    return finish(result, fetched)
+
+
 def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
                           member_specs, null_sentinels, strategy=None,
                           merge_mode="psum", timer=None):
@@ -1455,7 +2121,6 @@ def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
     from bqueryd_tpu.parallel import devicemerge
 
     n_dev = int(mesh.devices.size)
-    pack = packed_fetch_enabled() and not _packed_fetch_broken
     in_dtypes = (
         (str(codes_d.dtype),)
         + ((str(masks_d.dtype),) if masks_d is not None else ())
@@ -1491,49 +2156,178 @@ def _mesh_bundle_partials(mesh, axis, n_groups, codes_d, masks_d, measures_d,
         )
         return merged
 
-    if pack:
-        try:
-            program, spec = run(True)
-            with _collective_guard():
-                out = program(*args)
-                _block_ready(out)
-                with _fetch_phase(timer):
-                    flat = np.asarray(jax.device_get(out))
-        except Exception as exc:
-            if isinstance(
-                exc, jax.errors.JaxRuntimeError
-            ) and _transient_status(exc):
-                # transient infrastructure fault (same contract as
-                # _mesh_partials): NOT evidence against packing, and
-                # re-executing the whole N-member bundle per-leaf on the
-                # same flaky backend would double the device work —
-                # propagate so the worker's degrade/failover machinery
-                # decides
-                raise
-            import logging
+    return _fetch_merged(
+        run, lambda program: program(*args), merge_mode, n_dev, finish,
+        timer, latch=False, what="bundle",
+    )
 
-            logging.getLogger("bqueryd_tpu").exception(
-                "packed bundle fetch failed; retrying via per-leaf "
-                "device_get"
+
+@functools.lru_cache(maxsize=32)
+def _mesh_dag_program(mesh, axis, n_groups, in_dtypes, in_width, pack,
+                      classic_spec, topk_spec, sketch_spec, route=None,
+                      merge_mode="device"):
+    """Build + cache the jitted mesh program of one extended-DAG shape:
+    every aggregation's partial state emitted AND cross-device merged in
+    one compiled dispatch, so the only D2H is the final merged table.
+
+    Static specs (all in the lru key, like every knob that changes the
+    trace):
+
+    * ``classic_spec`` — ``((measure_slot, op, sentinel), ...)``: ONE
+      :func:`ops.partial_tables` dispatch (every kernel guard / strategy
+      route unchanged) whose bucketized output reduce-scatters span-owned
+      (the PR-7 ``devicemerge.scatter_merge_partials`` machinery);
+    * ``topk_spec`` — ``((slot, k, largest, drop_nan, sentinel,
+      float_neg), ...)``: dense ``[padded_groups, k]`` emission via
+      :func:`ops.relops.topk_dense_emit` — the SAME routed dispatcher
+      (matrix-argmax / k-pass / lexsort, all value-multiset identical)
+      the jitted per-shard kernel runs — merged by all-gather +
+      on-device re-select (:func:`devicemerge.allgather_topk_merge`);
+    * ``sketch_spec`` — ``((slot, log_gamma, imin, imax, kmin,
+      width), ...)``: dense bucket-count grids
+      (:func:`ops.relops.sketch_grid_block`) merged by reduce-scatter
+      ADDITION (:func:`devicemerge.scatter_merge_grid`) — the mergeable-
+      histogram property, now on the ICI instead of the host.
+
+    ``merge_mode`` is ``device`` or ``psum`` only: under the
+    ``BQUERYD_TPU_DEVICE_MERGE=0`` / ``BQUERYD_TPU_DAG_BATCH=0`` kill
+    switches the controller stops batching DAG dispatches, so no batched
+    program ever runs host-merged."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bqueryd_tpu import ops
+    from bqueryd_tpu.ops import relops
+    from bqueryd_tpu.parallel import devicemerge
+
+    n_dev = int(mesh.devices.size)
+    span, padded = devicemerge.bucket_span(n_groups, n_dev)
+    device_mode = merge_mode == devicemerge.MODE_DEVICE
+    g_emit = padded if device_mode else n_groups
+    span_arg = span if device_mode else None
+    spec = {}
+
+    def block_fn(codes_blk, *measure_blks):
+        codes = codes_blk[0]
+        per_slot = tuple(m[0] for m in measure_blks)
+        partials = ops.partial_tables(
+            codes,
+            tuple(per_slot[s] for s, _op, _st in classic_spec),
+            tuple(op for _s, op, _st in classic_spec),
+            n_groups,
+            null_sentinels=tuple(st for _s, _op, st in classic_spec),
+        )
+        if device_mode:
+            bucketized, sp = ops.bucketize_partials(
+                partials, n_groups, n_dev
+            )
+            classic = devicemerge.scatter_merge_partials(
+                bucketized, axis, n_dev, sp
             )
         else:
-            if merge_mode == devicemerge.MODE_PSUM:
-                merged = jax.tree_util.tree_unflatten(
-                    spec["treedef"], _unpack_host(flat, spec["leaves"])
+            classic = ops.psum_partials(partials, axis)
+        topk = []
+        for slot, k, largest, drop_nan, sentinel, float_neg in topk_spec:
+            dense, cnt = relops.topk_dense_emit(
+                codes, per_slot[slot], None, k, largest, g_emit,
+                drop_nan, sentinel, float_neg,
+            )
+            topk.append(
+                devicemerge.allgather_topk_merge(
+                    dense, cnt, axis, span_arg, largest, float_neg
                 )
-            else:
-                merged = _assemble_sharded(flat, spec, n_dev, merge_mode)
-            return finish(merged, flat.nbytes)
-    program, _spec = run(False)
-    with _collective_guard():
-        out = program(*args)
-        _block_ready(out)
-        with _fetch_phase(timer):
-            result = jax.device_get(out)
-    fetched = sum(
-        np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(result)
+            )
+        sketches = []
+        for slot, lg, imin, imax, kmin, width in sketch_spec:
+            grid = relops.sketch_grid_block(
+                codes, per_slot[slot], g_emit, lg, imin, imax, kmin,
+                width,
+            )
+            sketches.append(
+                devicemerge.scatter_merge_grid(grid, axis, span_arg)
+            )
+        merged = {
+            "classic": classic,
+            "topk": tuple(topk),
+            "sketch": tuple(sketches),
+        }
+        if not pack:
+            return merged
+        leaves, treedef = jax.tree_util.tree_flatten(merged)
+        spec["treedef"] = treedef
+        spec["leaves"] = tuple(
+            (np.dtype(leaf.dtype), tuple(leaf.shape)) for leaf in leaves
+        )
+        import jax.numpy as jnp
+
+        return jnp.concatenate([_pack_leaf(leaf).ravel() for leaf in leaves])
+
+    out_spec = P(axis) if device_mode else P()
+    fn = _shard_map(
+        block_fn,
+        mesh=mesh,
+        in_specs=tuple([P(axis, None)] * len(in_dtypes)),
+        out_specs=out_spec,
+        check=False,
     )
-    return finish(result, fetched)
+    from bqueryd_tpu.obs import profile as obsprofile
+
+    return obsprofile.instrument(
+        "executor.mesh_dag_program", jax.jit(fn)
+    ), spec
+
+
+def _mesh_dag_partials(mesh, axis, n_groups, codes_d, measures_d,
+                       classic_spec, topk_spec, sketch_spec,
+                       merge_mode="device", timer=None):
+    """Run the DAG program and return the merged pytree ON HOST (numpy
+    leaves, group axis leading, length ``n_groups`` = the program bucket):
+    one packed fetch for the whole query when packing is enabled, with the
+    per-leaf ``device_get`` fallback (same transient-vs-deterministic
+    contract as the bundle fetch — the worker's degrade path owns
+    failures).  Every leaf's group axis is fully merged: classic tables
+    ``[n_groups]``, top-k ``([n_groups, k], [n_groups])`` pairs, sketch
+    grids ``[n_groups, width]``."""
+    import jax
+
+    from bqueryd_tpu.parallel import devicemerge
+
+    n_dev = int(mesh.devices.size)
+    in_dtypes = (str(codes_d.dtype),) + tuple(
+        str(m.dtype) for m in measures_d
+    )
+    args = (codes_d,) + tuple(measures_d)
+
+    def run(pack_flag):
+        return _mesh_dag_program(
+            mesh, axis, int(n_groups), in_dtypes, int(codes_d.shape[1]),
+            pack_flag, classic_spec, topk_spec, sketch_spec,
+            route=_route_key(), merge_mode=merge_mode,
+        )
+
+    def finish(merged, fetched):
+        if merge_mode == devicemerge.MODE_DEVICE:
+            # device-mode leaves concatenate spans to the PADDED group
+            # axis; slice back to the program bucket (the caller slices
+            # the bucket down to the real group count)
+            merged = jax.tree_util.tree_map(
+                lambda a: a[: int(n_groups)], merged
+            )
+        # host-gather counterfactual: every device's full merged-size
+        # partial state crossing to the host (the =0 economics)
+        counterfactual = n_dev * sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(merged)
+        )
+        devicemerge.stats().record(
+            merge_mode, int(fetched), saved=counterfactual - int(fetched)
+        )
+        return merged
+
+    return _fetch_merged(
+        run, lambda program: program(*args), merge_mode, n_dev, finish,
+        timer, latch=True, what="DAG",
+    )
 
 
 #: set when the packed program failed to build/run on this backend (seen
@@ -1713,12 +2507,10 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
 
     ``timer``: optional PhaseTimer; the device→host fetch is carved into
     its own "fetch" phase so attribution can split kernel wall from D2H."""
-    global _packed_fetch_broken
     import jax
 
     from bqueryd_tpu.parallel import devicemerge
 
-    pack = packed_fetch_enabled() and not _packed_fetch_broken
     n_dev = int(mesh.devices.size)
     per_agg_measures = (
         measures_d
@@ -1759,76 +2551,7 @@ def _mesh_partials(mesh, axis, agg_ops, n_groups, codes_d, measures_d,
         )
         return merged
 
-    global _packed_transient_count
-    latch_pending = False
-    if pack:
-        try:
-            program, spec = run(True)
-            with _collective_guard():
-                out = program(codes_d, *measures_d)
-                _block_ready(out)
-                with _fetch_phase(timer):
-                    flat = np.asarray(jax.device_get(out))
-        except Exception as exc:
-            if (
-                isinstance(exc, jax.errors.JaxRuntimeError)
-                and _transient_status(exc)
-                and _packed_transient_count + 1 < _PACKED_TRANSIENT_LIMIT
-            ):
-                # transient infrastructure error (tunneled backends surface
-                # flaky remote-compile HTTP 500s as INTERNAL, dropped links
-                # as UNAVAILABLE): NOT evidence against packing — re-raise
-                # so the caller's retry re-attempts the packed program
-                # instead of latching the process into per-leaf fetch (one
-                # transport round-trip per result leaf) forever.  A
-                # DETERMINISTIC failure that happens to carry a transient
-                # status (e.g. an XLA lowering bug classed INTERNAL) is
-                # caught by the consecutive-failure cap: past the limit the
-                # latch path below runs after all.
-                _packed_transient_count += 1
-                raise
-            # packed compile/run failure must never fail the query: fall
-            # back to per-leaf fetch.  The process-lifetime latch commits
-            # only AFTER per-leaf succeeds below — per-leaf working while
-            # packed fails is the actual evidence against packing; if
-            # per-leaf fails too (whole backend down), the failure carried
-            # no packed-specific signal and must not latch.
-            latch_pending = True
-            import logging
-
-            logging.getLogger("bqueryd_tpu").exception(
-                "packed fetch failed; retrying this query via per-leaf "
-                "device_get"
-            )
-        else:
-            _packed_transient_count = 0
-            if merge_mode == devicemerge.MODE_PSUM:
-                leaves = _unpack_host(flat, spec["leaves"])
-                merged = jax.tree_util.tree_unflatten(
-                    spec["treedef"], leaves
-                )
-            else:
-                merged = _assemble_sharded(
-                    flat, spec, n_dev, merge_mode
-                )
-            return finish(merged, flat.nbytes)
-    program, _spec = run(False)
-    with _collective_guard():
-        out = program(codes_d, *measures_d)
-        _block_ready(out)
-        with _fetch_phase(timer):
-            result = jax.device_get(out)
-    if latch_pending:
-        _packed_fetch_broken = True
-        _packed_transient_count = 0
-        import logging
-
-        logging.getLogger("bqueryd_tpu").warning(
-            "packed fetch unavailable on this backend (per-leaf fetch "
-            "succeeded where the packed program failed); using per-leaf "
-            "device_get for the process lifetime"
-        )
-    fetched = sum(
-        np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(result)
+    return _fetch_merged(
+        run, lambda program: program(codes_d, *measures_d), merge_mode,
+        n_dev, finish, timer, latch=True, what="query",
     )
-    return finish(result, fetched)

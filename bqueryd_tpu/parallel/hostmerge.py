@@ -20,6 +20,15 @@ per SURVEY.md §7.4:
   reference's forced-'sum' merge double-counts them.  (The deliberately
   additive exception is ``sorted_count_distinct``: run counts are local to
   each shard's sort order by definition.)
+
+Extended DAG part kinds (top-k flat lists, sketch bucket vectors) merge
+here too — the k-way re-select and bucket-count addition below are the
+documented FALLBACK the mesh fast path's device merge is parity-pinned
+against (PR 15): batched DAG dispatches merge the same states on-device
+(``parallel.devicemerge.allgather_topk_merge`` /
+``scatter_merge_grid``), while per-shard dispatches
+(``BQUERYD_TPU_DAG_BATCH=0``, count_distinct shapes, sub-threshold row
+counts) and every cross-WORKER combine keep using this module.
 """
 
 import numpy as np

@@ -145,6 +145,35 @@ def sketch_flat(codes, values, n_groups, mask=None, alpha=0.01,
     return k_of.astype(np.int64), counts.astype(np.int64), offsets
 
 
+def sketch_grid_layout(alpha):
+    """``(width, kmin)`` of the DENSE signed-bucket grid for one alpha:
+    column ``j`` of a ``[groups, width]`` grid holds bucket key
+    ``kmin + j`` (negative magnitudes, the zero bucket, positive
+    magnitudes).  A pure function of ``alpha`` — every device of the mesh
+    fast path scatters into the SAME static grid, so the cross-device
+    merge is one reduce-scatter of bucket-count additions."""
+    _gamma, _lg, imin, imax = sketch_layout(alpha)
+    half = imax - imin + 1
+    return 2 * half + 1, -half
+
+
+def sketch_grid_to_flat(grid, kmin):
+    """Dense ``[groups, width]`` bucket-count grid -> the flat mergeable
+    form ``(keys, counts, offsets)``.  Row-major ``nonzero`` yields each
+    group's occupied buckets in ascending key order — exactly the layout
+    :func:`sketch_flat` / :func:`merge_sketch_parts` emit, so a device-
+    merged grid converts to a flat part bit-identical to the host path's
+    (zero cells simply vanish)."""
+    grid = np.asarray(grid)
+    g, col = np.nonzero(grid)
+    keys = col.astype(np.int64) + np.int64(kmin)
+    counts = grid[g, col].astype(np.int64)
+    offsets = np.searchsorted(
+        g, np.arange(grid.shape[0] + 1)
+    ).astype(np.int64)
+    return keys, counts, offsets
+
+
 def merge_sketch_parts(parts, n_global):
     """Bucket-count ADDITION across payloads.  ``parts`` is
     ``[(local_map, keys, counts, offsets), ...]``; returns the merged flat
@@ -251,6 +280,22 @@ def topk_flat(codes, values, k, largest, n_groups, mask=None, sentinel=None):
     )
 
 
+def dense_topk_to_flat(dense, counts):
+    """Dense best-first ``[groups, k]`` + per-group counts -> the flat
+    mergeable form ``(values, offsets)``: group ``g`` keeps its first
+    ``counts[g]`` slots.  Shared by the device kernel's host compaction
+    (``ops.relops.topk_partials``) and the mesh fast path's collect."""
+    dense = np.asarray(dense)
+    take = np.asarray(counts, dtype=np.int64)
+    n = len(take)
+    rep = np.repeat(np.arange(n, dtype=np.int64), take)
+    loc = _segment_local_arange(take)
+    flat = dense[rep, loc] if len(rep) else dense[:0, 0]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(take, out=offsets[1:])
+    return flat, offsets
+
+
 def merge_topk_parts(parts, k, largest, n_global):
     """K-way re-select across payloads: concatenate each group's flat
     top-k lists and re-select the global top-k."""
@@ -270,6 +315,33 @@ def merge_topk_parts(parts, k, largest, n_global):
         np.concatenate(gid_chunks), np.concatenate(val_chunks),
         k, largest, n_global,
     )
+
+
+def dim_measure_kind(dtype):
+    """``(null_sentinel, value_kind)`` of a join-selected measure column by
+    dtype — the ONE copy of the dtype rules ('datetime'/NaT, 'uint64',
+    'uint') shared by the per-shard route (:meth:`DagExecutor.
+    _measure_values`) and the mesh fast path (``executor.execute_dag``):
+    bit-parity between the two legs depends on these agreeing."""
+    dtype = np.dtype(dtype)
+    if dtype.kind == "M":
+        return NAT_SENTINEL, "datetime"
+    if dtype == np.dtype(np.uint64):
+        return None, "uint64"
+    if dtype.kind == "u":
+        return None, "uint"
+    return None, None
+
+
+def gathered_dim_values(dim_column, row_pos):
+    """Dimension column broadcast onto fact rows via the probe gather
+    (garbage where unmatched — those rows carry null codes and drop from
+    every reduction); datetime rides as raw int64 with the NaT sentinel.
+    Shared by both DAG routes, like :func:`dim_measure_kind`."""
+    v = np.asarray(dim_column)[np.maximum(row_pos, 0)]
+    if v.dtype.kind == "M":
+        v = v.astype("datetime64[ns]").view(np.int64)
+    return v
 
 
 def filter_flat(values_by_key, offsets, present):
@@ -472,17 +544,13 @@ class DagExecutor:
             return state.window_ints, NAT_SENTINEL, "datetime"
         if self._is_join_col(state, col):
             v = self._gathered(state, col)
-            if v.dtype.kind == "M":
+            sentinel, kind = dim_measure_kind(v.dtype)
+            if kind == "datetime":
                 return (
                     v.astype("datetime64[ns]").view(np.int64),
-                    NAT_SENTINEL, "datetime",
+                    sentinel, kind,
                 )
-            kind = None
-            if v.dtype == np.dtype(np.uint64):
-                kind = "uint64"
-            elif v.dtype.kind == "u":
-                kind = "uint"
-            return v, None, kind
+            return v, sentinel, kind
         table = state.table
         if col not in table:
             raise DagValidationError(

@@ -42,12 +42,14 @@ Control-plane module: **no JAX, no pandas** (NumPy only, for the broadcast
 dimension table's columns).
 """
 
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from bqueryd_tpu.models.query import (
     AGG_OPS,
+    MERGEABLE_OPS,
     freeze_value,
     normalize_agg_list,
 )
@@ -82,6 +84,34 @@ class DagValidationError(ValueError):
     def __init__(self, message, error_class="InvalidPlan"):
         super().__init__(message)
         self.error_class = error_class
+
+
+def dag_batch_enabled():
+    """The ``BQUERYD_TPU_DAG_BATCH`` kill switch (default on): batched
+    shard-group dispatch + device-resident merge for extended DAG queries.
+    ``0`` restores the PR-13 per-shard dispatch + host value-keyed merge
+    bit-identically — it is also the mixed-version fallback (keep it set
+    until every worker is >= PR-15, see MIGRATION) and the route
+    count_distinct / dict-measure DAGs always take."""
+    return os.environ.get("BQUERYD_TPU_DAG_BATCH", "1") != "0"
+
+
+def dag_batchable(dag):
+    """Whether this DAG's aggregations can ride ONE CalcMessage per shard
+    group with the device-resident merge: classic mergeable ops plus the
+    extended mergeable part kinds (top-k dense re-select, sketch
+    bucket-count addition).  ``count_distinct`` (per-group value SETS —
+    shipped, not reduce-scattered) and raw-rows keep the per-shard
+    dispatch, exactly like they always have on the classic path."""
+    if not dag_batch_enabled():
+        return False
+    if not dag.aggregate_rows:
+        return False
+    for _in_col, op, _out in dag.aggs:
+        kind = parse_op(op)[0]
+        if kind not in MERGEABLE_OPS and kind not in EXTENDED_OP_PREFIXES:
+            return False
+    return True
 
 
 def topk_limit():
@@ -337,6 +367,19 @@ class OperatorDAG:
         )
 
     # -- identity -----------------------------------------------------------
+    def derive_signature(self):
+        """Hashable identity of the DERIVATION pipeline alone — everything
+        that shapes the folded group codes and derived columns (group keys,
+        pushdown, join content, window geometry, post-derivation filter)
+        but NOT the agg list.  This is the content key the mesh fast path's
+        working-set entries (join-probe gathers, window-bucket keys, the
+        folded composite codes) live under: two DAG queries differing only
+        in measures/aggs share one decode/align/H2D pass."""
+        full = self.signature()
+        # ("dag", version, group_keys, aggs, pushdown, filter, join, window,
+        #  aggregate_rows, expand, sole) — drop the agg list (index 3)
+        return full[:3] + full[4:]
+
     def signature(self):
         """Hashable identity (result-cache key component; folded into the
         logical plan's signature so DAG queries never dedup-fuse with a
@@ -737,12 +780,15 @@ def groupby_equivalent(dag):
     """The groupby-shaped ``(LogicalPlan, kwargs)`` the controller's
     existing machinery dispatches: the plan carries the fact-side scan /
     pushdown (shard pruning works unchanged), the ordered physical agg
-    list (extended op strings included, so the shard-group batching
-    correctly declines to batch), and the DAG signature folded into the
-    plan signature (dedup/supersede can never confuse a DAG query with a
-    plain groupby of the same projection).  ``kwargs`` carries the wire
-    DAG under ``"dag"`` plus ``batch=False`` (extended partials merge
-    host-side per shard, like count_distinct always has)."""
+    list (extended op strings included), and the DAG signature folded into
+    the plan signature (dedup/supersede can never confuse a DAG query with
+    a plain groupby of the same projection).  ``kwargs`` carries the wire
+    DAG under ``"dag"`` plus the batching eligibility: device-mergeable
+    part kinds (classic + top-k + sketch) ship ONE CalcMessage per shard
+    group — the same ``_shard_groups`` path, failover and hedging
+    semantics as plain groupbys — while count_distinct / raw-rows shapes
+    (and everything under ``BQUERYD_TPU_DAG_BATCH=0``) keep the PR-13
+    per-shard dispatch with the host value-keyed merge."""
     from bqueryd_tpu.plan.logical import plan_groupby
 
     plan = plan_groupby(
@@ -753,4 +799,4 @@ def groupby_equivalent(dag):
         aggregate=dag.aggregate_rows,
     )
     plan.dag_sig = dag.signature()
-    return plan, {"batch": False, "dag": dag.to_wire()}
+    return plan, {"batch": dag_batchable(dag), "dag": dag.to_wire()}

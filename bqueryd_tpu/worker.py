@@ -1460,25 +1460,80 @@ class WorkerNode(WorkerBase):
 
     def _execute_dag(self, tables, dag, timer):
         """Extended operator-DAG execution (joins / top-k / quantile
-        sketches / window rollups): per-shard operator pipelines scheduled
-        on the PR-4 stage pool, host value-keyed merge — the same merge
-        (and failover/autopsy surface) non-psum-mergeable aggregations
-        always used.  Plain DAGs never reach here (handle_work routes them
+        sketches / window rollups).  Device-mergeable shapes (classic +
+        top-k + sketch part kinds) take the MESH FAST PATH: one
+        decode/align/H2D pass over the whole shard group and one compiled
+        mesh program whose span-owned collective merge ships only the
+        final table (``merge_mode`` "device") — the same execution
+        machinery plain groupbys have had since PR 7.  Everything else —
+        count_distinct sets, raw rows, object-dtype derived measures,
+        over-budget sketch grids, the ``BQUERYD_TPU_DAG_BATCH=0`` /
+        ``BQUERYD_TPU_DEVICE_MERGE=0`` kill switches, wedged backends, or
+        a failed device program — falls back to the PR-13 per-shard
+        operator pipelines on the stage pool with the host value-keyed
+        merge.  Plain DAGs never reach here (handle_work routes them
         through ``_execute`` bit-identically)."""
+        from bqueryd_tpu.models.query import host_kernel_rows
         from bqueryd_tpu.parallel.opexec import DagExecutor
+        from bqueryd_tpu.plan import dag as dagmod
 
-        executor = DagExecutor(self.engine)
         self._last_chunk_prune = None
+        total_rows = sum(int(t.nrows) for t in tables)
+        if (
+            dagmod.dag_batchable(dag)
+            and not devicehealth.backend_wedged()
+            and total_rows > host_kernel_rows()
+        ):
+            import jax
+
+            from bqueryd_tpu import ops as ops_mod
+            from bqueryd_tpu.parallel import executor as executor_mod
+
+            self.mesh_executor.timer = timer
+            try:
+                payload = self.mesh_executor.execute_dag(tables, dag)
+                self._last_effective_strategy = (
+                    self.mesh_executor.last_effective_strategy
+                )
+                self._last_merge_mode = (
+                    self.mesh_executor.last_merge_mode
+                )
+                self._fold_chunk_prune(
+                    self.mesh_executor.last_prune_counts
+                )
+                return payload
+            except executor_mod.DagFastPathUnsupported as exc:
+                self.logger.debug(
+                    "DAG fast path unavailable (%s); serving via the "
+                    "per-shard pipeline", exc,
+                )
+            except ops_mod.CompositeOverflow:
+                self.logger.info(
+                    "composite key space exceeds int64; serving the DAG "
+                    "via the per-shard pipeline"
+                )
+            except jax.errors.JaxRuntimeError as exc:
+                self.logger.warning(
+                    "DAG mesh program failed (%s); retrying via the "
+                    "per-shard pipeline",
+                    (str(exc).splitlines() or [""])[0][:200],
+                )
+        executor = DagExecutor(self.engine)
         payload = executor.execute(tables, dag, timer=timer)
         self._last_effective_strategy = executor.last_effective_strategy
         self._last_merge_mode = executor.last_merge_mode
-        decoded = sum(c[0] for c in executor._prune_counts)
-        skipped = sum(c[1] for c in executor._prune_counts)
+        self._fold_chunk_prune(executor._prune_counts)
+        return payload
+
+    def _fold_chunk_prune(self, prune_counts):
+        """Fold a DAG execution's per-shard (decoded, skipped) chunk-prune
+        counts into the worker counters + the prune-span tags."""
+        decoded = sum(c[0] for c in prune_counts)
+        skipped = sum(c[1] for c in prune_counts)
         if decoded or skipped:
             self.chunks_decoded_total.inc(decoded)
             self.chunks_skipped_total.inc(skipped)
             self._last_chunk_prune = (decoded, skipped)
-        return payload
 
     def _open_table(self, rootdir):
         """Table instances cached by meta identity: re-opening per query

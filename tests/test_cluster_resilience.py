@@ -1104,6 +1104,73 @@ def test_dag_topk_quantile_fails_over_under_kill_worker(
         _stop([controller] + workers, threads)
 
 
+def test_batched_dag_fails_over_as_whole_group_under_kill_worker(
+    tmp_path, mem_store_url
+):
+    """PR-15 acceptance: a BATCHED DAG query (top-k + quantile sketch over
+    one shard-group CalcMessage, the DAG fast path) survives the kill-worker
+    chaos plan with ZERO failed queries — the whole group fails over to the
+    replica holder (the PR-8/PR-9 bundle precedent), and the answer —
+    including the sketch buckets behind the quantile estimates — is
+    bit-equal to the fault-free baseline."""
+    import numpy as np
+
+    from bqueryd_tpu import chaos
+    from bqueryd_tpu.rpc import RPC
+
+    controller, workers, threads, _expected, shards = _replica_cluster(
+        tmp_path, mem_store_url
+    )
+    spec = {
+        "table": list(shards),
+        "groupby": ["g"],
+        "aggs": [
+            ["v", "sum", "s"],
+            ["v", "topk", "t3", {"k": 3}],
+            ["v", "quantile", "p50", {"q": 0.5, "alpha": 0.01}],
+        ],
+    }
+    try:
+        rpc = RPC(
+            coordination_url=mem_store_url, timeout=45,
+            loglevel=logging.WARNING,
+        )
+        before = controller.counters["dispatched_shards"]
+        baseline = rpc.query(spec)  # fault-free reference run
+        # the whole replica-held shard set rode ONE batched CalcMessage
+        assert controller.counters["dispatched_shards"] - before == 1
+        assert "device" in (rpc.last_call_merge_modes or {}).values()
+        chaos.arm({
+            "seed": 17,
+            "faults": [{
+                "site": "worker.execute",
+                "action": "die_after_ack",
+                "match": {"verb": "groupby"},
+                "times": 1,
+            }],
+        })
+        got = rpc.query(spec)
+        assert chaos.injected_total() >= 1
+        assert controller.counters["failover_dispatches"] >= 1
+        # zero failed queries, bit-equal to the fault-free run: int sums,
+        # top-k lists, and sketch estimates (same buckets, same counts,
+        # whichever holder served the whole group)
+        assert got["g"].tolist() == baseline["g"].tolist()
+        assert got["s"].tolist() == baseline["s"].tolist()
+        for a, b in zip(got["t3"], baseline["t3"]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            got["p50"].to_numpy(), baseline["p50"].to_numpy()
+        )
+        wait_until(
+            lambda: len(controller.worker_map) == 1,
+            desc="dead worker culled",
+        )
+    finally:
+        chaos.disarm()
+        _stop([controller] + workers, threads)
+
+
 def test_transient_device_fault_retries_on_other_holder(
     tmp_path, mem_store_url
 ):
