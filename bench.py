@@ -205,6 +205,11 @@ def start_cluster():
     lookup, not the engine (the kernel/storage caches stay on — they are the
     steady-state serving path being measured)."""
     os.environ["BQUERYD_TPU_RESULT_CACHE_BYTES"] = "0"
+    # Same rationale for semantic serving (PR 16): repeated identical
+    # queries would cross the rollup heat threshold and be answered from a
+    # materialized rollup — a controller-side lookup, not the engine.  The
+    # serving section measures it on its own cluster with SERVE=1.
+    os.environ["BQUERYD_TPU_SERVE"] = "0"
     from bqueryd_tpu.controller import ControllerNode
     from bqueryd_tpu.rpc import RPC
     from bqueryd_tpu.worker import WorkerNode
@@ -1797,6 +1802,309 @@ def run_ingest_section():
     if gate_on:
         bad = sorted(k for k, ok in gates.items() if not ok)
         assert not bad, f"ingest gates failed: {bad} — {detail}"
+    return detail
+
+
+def run_serving_section():
+    """Semantic serving (PR 16): the acceptance gates.
+
+    An 8-client zipf-weighted swarm over overlapping groupby shapes — one
+    hot ANCHOR view keyed finer than every satellite — runs twice over the
+    same 400k-row dataset: once with serving enabled (the anchor rollup
+    materializes once, the satellites are answered by key-fold /
+    agg-projection / zone-proof subsumption from it) and once forced to
+    recompute via the documented kill switch (``BQUERYD_TPU_SERVE=0``).
+    Gates (``BENCH_SERVING_GATE=0`` records without asserting):
+
+    * both ``rollup`` and ``subsume`` answer sources fire during the
+      serving leg;
+    * per-shape parity vs the forced-recompute leg — ints bit-exact,
+      floats within re-aggregation ulps;
+    * serving-leg QPS >= 5x the forced-recompute leg;
+    * the kill-switch leg serves zero rollup/subsume answers and repeats
+      bit-identically (the exact-signature-only PR-15 behaviour).
+
+    Runs over its own dataset/cluster; main-measurement clusters pin
+    ``BQUERYD_TPU_SERVE=0`` (see start_cluster) so rollups can never
+    short-circuit the walls the other sections measure.
+    """
+    import shutil
+
+    import pandas as pd
+
+    gate_on = os.environ.get("BENCH_SERVING_GATE", "1") == "1"
+    detail = {}
+    rows_serving = min(ROWS, 400_000)
+    n_shards = 2
+    per = rows_serving // n_shards
+    chunklen = max(4096, per // 16)
+    base_dir = os.path.join(DATA_DIR, "serving")
+    shutil.rmtree(base_dir, ignore_errors=True)
+    os.makedirs(base_dir, exist_ok=True)
+    from bqueryd_tpu.storage.ctable import ctable
+
+    rng = np.random.RandomState(29)
+    names = [f"srv_{i}.bcolzs" for i in range(n_shards)]
+    for i, name in enumerate(names):
+        df = pd.DataFrame(
+            {
+                "g": rng.randint(0, 8, per).astype(np.int64),
+                "g2": rng.randint(0, 4, per).astype(np.int64),
+                "v": rng.randint(-10000, 10000, per).astype(np.int64),
+                "f": rng.random(per).astype(np.float32),
+                # per-shard-monotonic: the zone-proof axis
+                "seq": np.arange(i * per, (i + 1) * per, dtype=np.int64),
+            }
+        )
+        ctable.fromdataframe(
+            df, os.path.join(base_dir, name), chunklen=chunklen
+        )
+    detail["rows"] = rows_serving
+    detail["shards"] = n_shards
+
+    aggs = [["v", "sum", "vs"], ["f", "mean", "fm"], ["v", "min", "vmin"]]
+    # the anchor is keyed finer than every satellite: ONE materialized
+    # rollup provably answers all of them through the lattice
+    pool = [
+        ("anchor", (list(names), ["g", "g2"], aggs, [])),
+        ("coarse", (list(names), ["g"], aggs, [])),
+        ("zone", (list(names), ["g", "g2"], aggs, [["seq", ">=", 0]])),
+        ("project", (list(names), ["g"], [["v", "sum", "vs"]], [])),
+        ("coarse2", (list(names), ["g2"], aggs, [])),
+    ]
+    weights = np.array([0.4, 0.2, 0.15, 0.15, 0.1])
+
+    def frames_close(sa, sb, keys, agg_list):
+        """(ints_bitexact, float_max_rel_err) over one answer pair."""
+        ints = all(
+            np.array_equal(sa[k].to_numpy(), sb[k].to_numpy()) for k in keys
+        )
+        rel = 0.0
+        for _col, op, out in agg_list:
+            x = sa[out].to_numpy()
+            y = sb[out].to_numpy()
+            if op == "mean":
+                with np.errstate(all="ignore"):
+                    r = (
+                        float(
+                            np.nanmax(
+                                np.abs(
+                                    x.astype(np.float64)
+                                    - y.astype(np.float64)
+                                )
+                                / np.maximum(
+                                    np.abs(y.astype(np.float64)), 1e-30
+                                )
+                            )
+                        )
+                        if len(x) else 0.0
+                    )
+                rel = max(rel, r)
+            else:
+                ints = ints and np.array_equal(x, y)
+        return ints, rel
+
+    prior_env = {
+        k: os.environ.get(k)
+        for k in (
+            "BQUERYD_TPU_SERVE",
+            "BQUERYD_TPU_ROLLUP_HEAT_MIN",
+            "BQUERYD_TPU_RESULT_CACHE_BYTES",
+        )
+    }
+    # the gate compares against FORCED recompute: with the worker's
+    # exact-signature result cache on, the kill-switch leg would measure
+    # cache lookups (only 5 distinct shapes in the pool), not the engine
+    os.environ["BQUERYD_TPU_RESULT_CACHE_BYTES"] = "0"
+    rpc, controller, workers, nodes, threads = _ingest_cluster(
+        base_dir, "serving", n_shards
+    )
+    try:
+        # the cost model refuses to serve before stats advertise; the
+        # one-shot WRM advertisement has a 60s re-send window, so force it
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(
+                (controller.shard_stats.get(n) or {}).get("rows") == per
+                for n in names
+            ):
+                break
+            for w in workers:
+                w._stats_sent_ts = 0.0
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("serving stats never advertised")
+
+        def swarm(n_clients=8, per_client=24, seed=101):
+            from bqueryd_tpu.rpc import RPC as _RPC
+
+            walls = [None] * n_clients
+            tallies = [None] * n_clients
+            frames = [None] * n_clients
+            errors = []
+
+            def client(ci):
+                r = np.random.RandomState(seed + ci)
+                try:
+                    cli = _RPC(
+                        coordination_url=controller.store.url,
+                        timeout=RPC_TIMEOUT, loglevel=logging.WARNING,
+                    )
+                    tally, got = {}, {}
+                    t0 = time.perf_counter()
+                    for _ in range(per_client):
+                        qname, q = pool[r.choice(len(pool), p=weights)]
+                        df = cli.groupby(*q)
+                        src = cli.last_call_answer_source or "recompute"
+                        tally[src] = tally.get(src, 0) + 1
+                        got[qname] = df
+                    walls[ci] = time.perf_counter() - t0
+                    tallies[ci] = tally
+                    frames[ci] = got
+                    cli._close_socket()
+                except Exception as exc:
+                    errors.append(repr(exc))
+
+            ts = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"swarm client errors: {errors[:3]}")
+            sources, merged = {}, {}
+            for t_ in tallies:
+                for k, v in (t_ or {}).items():
+                    sources[k] = sources.get(k, 0) + v
+            for fr in frames:
+                for k, v in (fr or {}).items():
+                    merged.setdefault(k, v)
+            return n_clients * per_client / elapsed, sources, merged
+
+        # -- serving leg: materialize the anchor, then the swarm ----------
+        # HEAT_MIN=1: the first anchor query crosses the threshold (EWMA
+        # decay puts N spaced hits fractionally under N, so an integer
+        # threshold of 2 would need 3 queries)
+        os.environ["BQUERYD_TPU_SERVE"] = "1"
+        os.environ["BQUERYD_TPU_ROLLUP_HEAT_MIN"] = "1"
+        q_anchor = pool[0][1]
+        rpc.groupby(*q_anchor)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if any(
+                e.state == "ready"
+                for e in list(controller.serving.manager.entries.values())
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("anchor rollup never materialized")
+        # freeze further materialization: the satellites must stay
+        # SUBSUMED from the anchor (the lattice is what's measured), not
+        # grow their own exact rollups mid-swarm
+        os.environ["BQUERYD_TPU_ROLLUP_HEAT_MIN"] = "1e18"
+        qps_serving, sources_serving, frames_serving = swarm()
+
+        # -- forced-recompute leg (the documented kill switch) ------------
+        os.environ["BQUERYD_TPU_SERVE"] = "0"
+        qps_recompute, sources_recompute, frames_recompute = swarm(seed=202)
+
+        # kill-switch determinism probe: the repeat is bit-identical (the
+        # exact-signature-only PR-15 path, nothing served)
+        ka = rpc.groupby(*q_anchor).sort_values(
+            ["g", "g2"]
+        ).reset_index(drop=True)
+        kb = rpc.groupby(*q_anchor).sort_values(
+            ["g", "g2"]
+        ).reset_index(drop=True)
+        kill_ints, kill_rel = frames_close(ka, kb, ["g", "g2"], aggs)
+        kill_identical = kill_ints and kill_rel == 0.0
+
+        ints_ok, fmax = True, 0.0
+        for qname, (_n, keys, qa, _w) in pool:
+            if qname not in frames_serving or qname not in frames_recompute:
+                continue
+            sa = frames_serving[qname].sort_values(keys).reset_index(
+                drop=True
+            )
+            sb = frames_recompute[qname].sort_values(keys).reset_index(
+                drop=True
+            )
+            ints, rel = frames_close(sa, sb, keys, qa)
+            ints_ok = ints_ok and ints
+            fmax = max(fmax, rel)
+
+        detail["swarm"] = {
+            "clients": 8,
+            "queries_per_client": 24,
+            "serving_qps": round(qps_serving, 2),
+            "recompute_qps": round(qps_recompute, 2),
+            "qps_ratio": round(qps_serving / qps_recompute, 3),
+            "sources_serving": sources_serving,
+            "sources_recompute": sources_recompute,
+        }
+        detail["parity"] = {
+            "ints_bitexact": ints_ok,
+            "float_max_rel_err": fmax,
+        }
+        detail["kill_switch"] = {
+            "bit_identical_repeat": kill_identical,
+            "sources": sources_recompute,
+        }
+        detail["rollup_builds"] = int(controller.counters["rollup_builds"])
+        detail["note"] = (
+            "8-client zipf swarm over 5 overlapping groupby shapes; one "
+            "anchor rollup (keys g,g2) answers the satellites via "
+            "key-fold/agg-projection/zone-proof subsumption.  Gates: "
+            "rollup+subsume hits > 0, serving QPS >= 5x forced recompute, "
+            "ints bit-exact / floats to re-aggregation ulps, "
+            "BQUERYD_TPU_SERVE=0 leg serves nothing and repeats "
+            "bit-identically"
+        )
+        print(
+            f"[bench] serving: {qps_serving:.1f} qps vs recompute "
+            f"{qps_recompute:.1f} qps "
+            f"({qps_serving / qps_recompute:.1f}x), sources "
+            f"{sources_serving}, parity ints {ints_ok} "
+            f"float_rel {fmax:.2e}",
+            flush=True,
+        )
+    finally:
+        for k, v in prior_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for node in nodes:
+            node.running = False
+        for t in threads:
+            t.join(timeout=5)
+        try:
+            rpc._close_socket()
+        except Exception:
+            pass
+
+    gates = {
+        "rollup_hits_gt_0": sources_serving.get("rollup", 0) > 0,
+        "subsume_hits_gt_0": sources_serving.get("subsume", 0) > 0,
+        "parity_ints_bitexact": ints_ok,
+        "parity_float_ulps": fmax < 2e-5,
+        "serving_qps_ge_5x": qps_serving >= 5.0 * qps_recompute,
+        "kill_switch_no_serving": (
+            sources_recompute.get("rollup", 0) == 0
+            and sources_recompute.get("subsume", 0) == 0
+        ),
+        "kill_switch_deterministic": kill_identical,
+    }
+    detail["gates"] = gates
+    if gate_on:
+        bad = sorted(k for k, ok in gates.items() if not ok)
+        assert not bad, f"serving gates failed: {bad} — {detail}"
     return detail
 
 
@@ -3407,6 +3715,29 @@ def main():
                     flush=True,
                 )
 
+        # serving: semantic serving layer (PR 16) — zipf swarm QPS with
+        # rollup + subsumption answers vs the forced-recompute kill
+        # switch, parity and bit-identical kill-switch gates, over the
+        # section's OWN dataset/cluster (the main clusters pin SERVE=0)
+        serving_detail = {}
+        if (
+            os.environ.get("BENCH_SERVING", "1") == "1"
+            and not wedged
+            and HEADLINE in completed
+        ):
+            try:
+                serving_detail = run_serving_section()
+            except AssertionError:
+                raise  # the serving gate is deterministic: fail the bench
+            except Exception as exc:
+                if os.environ.get("BENCH_SERVING_GATE", "1") == "1":
+                    raise
+                print(
+                    f"[bench] serving section failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
         # chaos: the zero-failed-query degradation gate — scripted
         # kill-worker / drop-reply / wedge-device / redis-partition
         # scenarios over fresh 2-replica clusters of the same dataset,
@@ -3578,6 +3909,9 @@ def main():
             # zone-map chunk-decode fraction + bit-identity, and the
             # append-while-querying chaos parity gate
             "ingest": ingest_detail,
+            # semantic serving: zipf-swarm QPS vs forced recompute,
+            # rollup/subsume hit mix, parity, kill-switch bit-identity
+            "serving": serving_detail,
             # fault-injection scenarios: zero-failed-query gate, result
             # parity vs the fault-free run, failover/hedge counters
             "chaos": chaos_detail,

@@ -357,6 +357,27 @@ ENV_REGISTRY = {
         _v("DELTA_CACHE_BYTES", "int", "128 MiB",
            "byte budget of the worker's delta-maintained aggregate cache",
            related=("DELTA_SERVE",)),
+        _v("SERVE", "flag", "1",
+           "semantic serving layer (PR 16): answer admitted queries from "
+           "materialized rollups via plan subsumption (0 = exact-signature "
+           "caches only, bit-identical to the pre-serving tree)",
+           related=("ROLLUP_MAX", "ROLLUP_HEAT_MIN", "ROLLUP_CACHE_BYTES",
+                    "DELTA_SERVE")),
+        _v("ROLLUP_MAX", "int", "16",
+           "max materialized rollup entries held controller-side",
+           related=("SERVE", "ROLLUP_CACHE_BYTES")),
+        _v("ROLLUP_HEAT_MIN", "float", "3.0",
+           "decayed hit-score a plan view must reach before the controller "
+           "materializes a rollup for it",
+           related=("SERVE", "ROLLUP_HEAT_HALFLIFE_S")),
+        _v("ROLLUP_HEAT_HALFLIFE_S", "float", "300",
+           "half-life (seconds) of the rollup heat tracker's exponential "
+           "decay",
+           related=("ROLLUP_HEAT_MIN",)),
+        _v("ROLLUP_CACHE_BYTES", "int", "256 MiB",
+           "byte budget for stored rollup partials (least-recently-hit "
+           "entries evicted past it)",
+           related=("ROLLUP_MAX", "SERVE")),
     ]
 }
 

@@ -143,6 +143,15 @@ COUNTER_SPECS = {
     "append_dispatches":
         "per-holder append CalcMessages dispatched (one per distinct "
         "(node, data_dir) replica of the target shard)",
+    "rollup_builds":
+        "materialized-rollup shard builds completed (serve.rollup full "
+        "rebuilds; delta refreshes count separately)",
+    "rollup_refreshes":
+        "rollup shard refreshes served by aggregating only appended tail "
+        "chunks (growth_since exact-prefix validation)",
+    "rollup_evictions":
+        "rollup entries dropped by the retention sweep (count/byte caps, "
+        "wedged-build timeout)",
 }
 
 
@@ -269,6 +278,14 @@ class ControllerNode:
         from bqueryd_tpu.plan import calibrate as _calibrate
 
         self.calibration = _calibrate.CalibrationStore()
+        # -- semantic serving (PR 16) ---------------------------------------
+        # subsumption lattice + materialized-rollup manager (serve/): hit
+        # replies skip admission entirely; BQUERYD_TPU_SERVE=0 makes every
+        # entry point a no-op without tearing the object down
+        from bqueryd_tpu.serve import ServingLayer
+
+        self.serving = ServingLayer(self)
+        self._rollup_waiters = {}     # dispatch token -> (entry key, filename)
         self._work_subscribers = {}   # shard token -> [parent_token, ...]
         self._work_keys = {}          # shard token -> shared-dispatch key
         self._work_index = {}         # shared-dispatch key -> shard token
@@ -553,6 +570,9 @@ class ControllerNode:
         # BQUERYD_TPU_TIMELINE_INTERVAL_S (the ring paces itself; <=0
         # disables), served by rpc.timeline()
         self.timeline_ring.maybe_snapshot(self._timeline_snapshot, now=now)
+        # serving housekeeping: abandon wedged rollup builds, enforce the
+        # retention caps, dispatch delta refreshes for stale entries
+        self.serving.tick()
         self.store.sadd(bqueryd_tpu.REDIS_SET_KEY, self.address)
         current = self.store.smembers(bqueryd_tpu.REDIS_SET_KEY)
         for addr in current:
@@ -1842,6 +1862,17 @@ class ControllerNode:
                 "append_reply_orphaned", token=token,
             )
             return
+        if token is not None and token in self._rollup_waiters:
+            # controller-originated rollup build/refresh reply: absorbed
+            # into the serving layer, never forwarded to any client
+            self._absorb_rollup_reply(token, msg)
+            return
+        if isinstance(token, str) and token.startswith("rollup_"):
+            # orphaned rollup reply: the entry was evicted/abandoned while
+            # the build was in flight (same prefix-match rationale as the
+            # append orphan above — ErrorMessage payloads aren't the verb)
+            self.flight.record("rollup_reply_orphaned", token=token)
+            return
         subscribers = self._work_subscribers.get(token)
         if entry is not None and not (
             msg.isa(ErrorMessage) and msg.get("transient")
@@ -2132,11 +2163,27 @@ class ControllerNode:
         # 130+ char key that bloated the bench's one-line JSON past what
         # log tails keep intact); same labelling as the slow-query log
         timings = self._compact_timings(segment["timings"])
+        # answer provenance for the dispatched path: every shard served
+        # from a worker result cache -> "cached"; any delta-maintained
+        # shard -> "delta"; else a real recompute.  The serving layer's
+        # direct replies (_reply_served) stamp "rollup"/"subsume"
+        effective_routes = set((segment.get("effective") or {}).values())
+        if effective_routes and effective_routes <= {"cached"}:
+            answer_source = "cached"
+        elif "delta" in effective_routes:
+            answer_source = "delta"
+        else:
+            answer_source = "recompute"
+        self._count_answer(answer_source)
         reply = pickle.dumps(
             {
                 "ok": True,
                 "payloads": payloads,
                 "timings": timings,
+                # PR-16 provenance: how this answer was produced, and (for
+                # subsumption serves) which materialized view proved it
+                "answer_source": answer_source,
+                "subsumed_from": None,
                 # planner visibility end to end: the hints issued and the
                 # routes the workers actually compiled post-guards (bench's
                 # chosen_strategy / regret accounting read these)
@@ -2608,7 +2655,7 @@ class ControllerNode:
 
     def rpc_debug_bundle(self, msg):
         """``rpc.debug_bundle(trace_id=None)``: the cross-node forensic
-        artifact (schema ``bqueryd_tpu.debug_bundle/3``) — flight rings,
+        artifact (schema ``bqueryd_tpu.debug_bundle/4``) — flight rings,
         the requested (or newest) trace timeline, metrics and slow-query
         snapshots, per-worker compile registries and device health.  One
         JSON-safe dict you can attach to a bug report; dead peers degrade
@@ -2681,6 +2728,10 @@ class ControllerNode:
             # recommendations — freshly evaluated, the bundle must not
             # ship a stale saturation verdict
             "capacity": self._capacity_bundle_section(),
+            # the semantic serving layer (PR 16, schema /4): rollup entry
+            # states + heat, and the last subsumption decisions with their
+            # rejected candidates and reasons
+            "serving": self.serving.snapshot(),
         }
         snapshots = {}
         for worker_id in set(self.worker_map) | set(self._worker_debug):
@@ -2959,6 +3010,10 @@ class ControllerNode:
             info = self.worker_map.get(worker_id) or {}
             group = (info.get("node"), info.get("data_dir") or worker_id)
             targets.setdefault(group, worker_id)
+        # rollups covering this shard go stale BEFORE any worker mutates
+        # its replica: a stale-but-unchanged entry refreshes back to ready,
+        # the reverse order could serve pre-append partials as fresh
+        self.serving.note_append(filename)
         deadline = msg.get("deadline")
         seg_key = f"append_{os.urandom(8).hex()}"
         segment = {
@@ -3224,6 +3279,157 @@ class ControllerNode:
             )
         self._admit_plan(msg, plan, kwargs)
 
+    # -- semantic serving wire plumbing (PR 16) ---------------------------
+    # All rollup message construction and reply absorption live HERE (not
+    # in serve/) so the wire lint audits every key both ways.
+
+    def _dispatch_rollup_build(self, entry, prior=None):
+        """Fan one ``rollup`` CalcMessage per shard of a rollup entry to a
+        live holder.  A refresh (``prior`` set) ships each shard's previous
+        partials plus the chunk-prefix fingerprint they were computed
+        against; the worker delta-aggregates only the appended tail when
+        the prefix still validates (ops.workingset.growth_since)."""
+        spec = entry.spec
+        dag_blob = None
+        if spec.get("dag_wire") is not None:
+            dag_blob = base64.b64encode(
+                pickle.dumps(
+                    spec["dag_wire"], protocol=messages.PICKLE_PROTOCOL
+                )
+            ).decode("ascii")
+        keys, agg_list, where = spec["args"]
+        for fname in entry.filenames:
+            holders = self.files_map.get(fname) or set()
+            worker_id = next(
+                (w for w in sorted(holders) if w in self.worker_map), None
+            )
+            if worker_id is None:
+                self.serving.manager.fail(entry.key, "no-holder")
+                self.flight.record(
+                    "rollup_build_failed", entry=entry.key,
+                    filename=fname, reason="no-holder",
+                )
+                return
+            calc = CalcMessage({
+                "payload": "rollup",
+                "filename": fname,
+                "token": f"rollup_{os.urandom(8).hex()}",
+                "worker_id": worker_id,
+            })
+            calc.set_args_kwargs(
+                [fname, keys, agg_list, where], {"aggregate": True}
+            )
+            if dag_blob is not None:
+                calc["dag"] = dag_blob
+            pinfo = (prior or {}).get(fname) or {}
+            if pinfo.get("data") and pinfo.get("base") is not None:
+                # partials bytes ride base64-framed: the calc wire is JSON
+                calc.add_as_binary("rollup_prior", pinfo["data"])
+                calc.add_as_binary("rollup_base", pinfo["base"])
+            self._rollup_waiters[calc["token"]] = (entry.key, fname)
+            self.worker_out_messages.setdefault(worker_id, []).append(calc)
+        self.flight.record(
+            "rollup_dispatch", entry=entry.key,
+            shards=len(entry.filenames), refresh=prior is not None,
+        )
+
+    def _absorb_rollup_reply(self, token, msg):
+        """One shard's rollup build/refresh reply: parse the partials and
+        proof metadata into the serving layer.  An error reply (including
+        a pre-PR-16 worker's base-handler rejection of the verb) drops the
+        whole entry — serving simply stays on the recompute path."""
+        key, fname = self._rollup_waiters.pop(token)
+        if msg.isa(ErrorMessage):
+            text = str(msg.get("payload") or "rollup build failed")
+            if "unhandled message payload" in text:
+                text = (
+                    "UnsupportedVerb: worker predates semantic serving "
+                    "(PR 16); rollups stay disabled until calc workers "
+                    "are upgraded"
+                )
+            else:
+                text = (text.strip().splitlines() or ["failed"])[-1]
+            self.serving.manager.fail(key, text)
+            self.flight.record(
+                "rollup_build_failed", entry=key, filename=fname,
+                reason=text[:200],
+            )
+            return
+        from bqueryd_tpu.models.query import ResultPayload
+
+        data = msg.get("data")
+        mode = msg.get("rollup_mode") or "rebuild"
+        base = (
+            msg.get_from_binary("rollup_base")
+            if msg.get("rollup_base") else None
+        )
+        zones = (
+            msg.get_from_binary("rollup_zones")
+            if msg.get("rollup_zones") else {}
+        )
+        try:
+            payload = dict(ResultPayload.from_bytes(data))
+        except Exception:
+            self.serving.manager.fail(key, "undecodable payload")
+            return
+        groups = (
+            len(payload.get("rows", ()))
+            if payload.get("kind") == "partials" else 0
+        )
+        state = self.serving.absorb_build(key, fname, {
+            "data": data,
+            "payload": payload,
+            "base": base,
+            "zones": zones,
+            "groups": int(groups),
+            "mode": mode,
+        })
+        if mode == "delta":
+            self.counters["rollup_refreshes"] += 1
+        elif mode == "rebuild":
+            self.counters["rollup_builds"] += 1
+        if state == "ready":
+            self.flight.record(
+                "rollup_materialized", entry=key, mode=mode,
+            )
+
+    def _reply_served(self, msg, payloads, source, subsumed_from):
+        """Answer a groupby-shaped verb straight from the serving layer.
+        The envelope mirrors _maybe_complete_segment's (empty timing /
+        strategy maps: nothing was dispatched) plus the PR-16 provenance
+        pair.  A live admission ticket on this REQ identity is retired
+        first — the REQ socket is lockstep, so the abandoned run's reply
+        would otherwise mis-pair with this client's next request."""
+        token = msg["token"]
+        if token in self._ticket_sigs:
+            self._cancel_ticket(token)
+        self._count_answer(source)
+        self.reply_rpc_raw(
+            token,
+            pickle.dumps(
+                {
+                    "ok": True,
+                    "payloads": payloads,
+                    "timings": {},
+                    "strategies": {"hints": {}, "effective": {}},
+                    "merge_modes": {},
+                    "answer_source": source,
+                    "subsumed_from": subsumed_from,
+                },
+                protocol=4,
+            ),
+        )
+
+    def _count_answer(self, source):
+        """Per-source answer provenance counter (every client reply path
+        funnels through here exactly once)."""
+        self.metrics.counter(
+            "bqueryd_tpu_serve_answers_total",
+            "groupby answers by provenance source "
+            "(recompute|cached|delta|rollup|subsume)",
+            labels={"source": source},
+        ).inc()
+
     def _admit_plan(self, msg, plan, kwargs):
         """Shared admission tail of the groupby-shaped verbs (groupby and
         query): unknown-shard check, quota/dedup/supersede handling, BUSY
@@ -3233,6 +3439,15 @@ class ControllerNode:
         unknown = [f for f in plan.filenames if f not in self.files_map]
         if unknown:
             raise ValueError(f"filenames not found on any worker: {unknown}")
+
+        # semantic serving (PR 16): a provable subsumption/rollup hit
+        # answers right here — no admission slot, no dispatch, no scan.
+        # Misses (and every refusal) fall through bit-identically to the
+        # pre-serving pipeline; _reply_served retires any live ticket on
+        # this REQ identity first (a timed-out resend), since that run's
+        # eventual reply would mis-pair with the client's next request
+        if self.serving.try_serve(msg, plan, kwargs):
+            return
 
         # admission: the REQ token is the ticket (one live ticket per
         # lockstep REQ socket); the quota key is the client-declared

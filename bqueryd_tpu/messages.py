@@ -110,6 +110,25 @@ ENVELOPE_SCHEMA = {
            "sketch_offsets (parallel.opexec)",
     "worker_id": "explicit dispatch target / WRM sender identity",
     "ticket": "download/movebcolz ticket id",
+    # controller-originated rollup build/refresh (PR 16, serve.rollup)
+    "rollup_prior": "on rollup refresh dispatches: the entry's previous "
+                    "partials bytes for this shard — the worker merges the "
+                    "appended tail into them when the stored chunk prefix "
+                    "still validates (ops.workingset.growth_since)",
+    "rollup_base": "base64-pickled chunk-prefix fingerprint "
+                   "(ops.workingset.table_growth_base): on refresh "
+                   "dispatches, the prefix the prior partials were computed "
+                   "against; on build/refresh replies, the fingerprint of "
+                   "the shard the returned partials cover — the next "
+                   "refresh validates against it",
+    "rollup_mode": "rollup reply provenance: 'rebuild' (full scan), 'delta' "
+                   "(tail chunks aggregated and hostmerged into the "
+                   "prior), 'fresh' (no growth, prior returned verbatim)",
+    "rollup_zones": "base64-pickled per-column census of the shard the "
+                    "rollup covers ({col: {kind, zones, nulls}}): dtype "
+                    "kind plus per-chunk (min,max) zone maps — what the "
+                    "subsumption lattice's key-fold null-freedom and "
+                    "full-chunk filter proofs check (serve.subsume)",
     # worker -> controller replies
     "data": "raw result payload bytes",
     "phase_timings": "per-phase seconds dict; whole-call wall under _total",
@@ -220,6 +239,15 @@ RESULT_ENVELOPE_SCHEMA = {
     "attempts": "per-attempt worker/fault history ({worker, reason, "
                 "retries, ts} dicts) behind an error_class failure — the "
                 "flight-recorder trail a client can act on",
+    "answer_source": "answer provenance (PR 16): 'recompute' | 'cached' "
+                     "(every shard from a worker result cache) | 'delta' "
+                     "(delta-maintained refresh) | 'rollup' (materialized "
+                     "rollup served verbatim) | 'subsume' (folded from a "
+                     "finer rollup by the subsumption lattice); surfaced "
+                     "as rpc.last_call_answer_source",
+    "subsumed_from": "on rollup/subsume answers: the materialized-view key "
+                     "the answer was proven from (serve.subsume.view_key); "
+                     "None on dispatched answers",
 }
 
 #: keys legitimately touched on only one side of the wire MODULES — the peer

@@ -42,7 +42,12 @@ import time
 #: shard heat map, predicted-vs-measured queue delay, last shadow
 #: recommendations; see obs.capacity).  /1 and /2 consumers keep working:
 #: nothing was removed or renamed.
-BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/3"
+#: schema /4 (PR 16): additive ``serving`` controller-section key — the
+#: semantic serving layer's snapshot (materialized-rollup entry states,
+#: tracked-view heat, append epochs, and the most recent subsumption
+#: decisions with chosen source + rejected candidates and reasons; see
+#: bqueryd_tpu.serve).  Earlier consumers keep working unchanged.
+BUNDLE_SCHEMA = "bqueryd_tpu.debug_bundle/4"
 
 DEFAULT_CAPACITY = 512
 DEFAULT_MAX_BYTES = 1 << 20  # 1 MiB of ring per node

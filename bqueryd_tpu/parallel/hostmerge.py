@@ -220,6 +220,34 @@ def _merge_partials(payloads):
     if len(payloads) == 1:
         return dict(first)
 
+    return _merge_aligned(payloads, key_cols, ops, out_cols, value_kinds)
+
+
+def collapse_partials(payload):
+    """Collapse duplicate key tuples inside ONE partials payload.
+
+    A freshly-executed partial has unique keys, but a payload whose key
+    columns were *rewritten* — a window re-floored onto a coarser grid, a
+    group-key column dropped (serve.subsume folds) — maps several stored
+    groups onto the same key tuple.  Re-aggregating them is exactly the
+    cross-shard merge with one payload, so this routes through the same
+    value-kinds rules (_MERGE_RULES, extremum fills, distinct unions).
+    """
+    if payload.get("kind") != "partials" or not len(payload.get("rows", ())):
+        return payload
+    return _merge_aligned(
+        [payload],
+        payload["key_cols"],
+        payload["ops"],
+        payload["out_cols"],
+        payload.get("value_kinds"),
+    )
+
+
+def _merge_aligned(payloads, key_cols, ops, out_cols, value_kinds):
+    """Shape-validated merge core: align key tuples globally and combine
+    every aggregation part under its merge rule."""
+    first = payloads[0]
     group_of, n_global, global_keys = _align_groups(payloads, key_cols)
 
     def scatter(rule, parts, dtype):

@@ -94,6 +94,12 @@ class RPC:
         #: ("device" = ICI-mesh collective merge, "host" = hostmerge
         #: fallback, "none" = single payload) — how the answer was merged
         self.last_call_merge_modes = None
+        #: answer provenance of the most recent groupby reply (PR 16):
+        #: "recompute" | "cached" | "delta" | "rollup" | "subsume" — and,
+        #: for subsumption serves, the materialized view that proved it.
+        #: None against a pre-PR-16 controller.
+        self.last_call_answer_source = None
+        self.last_call_subsumed_from = None
         #: client-side deserialize+merge wall of the most recent groupby —
         #: the one segment the controller cannot see; ``autopsy()`` folds it
         #: into the fetched attribution record
@@ -318,6 +324,8 @@ class RPC:
         self.last_call_timings = envelope.get("timings")
         self.last_call_strategies = envelope.get("strategies")
         self.last_call_merge_modes = envelope.get("merge_modes")
+        self.last_call_answer_source = envelope.get("answer_source")
+        self.last_call_subsumed_from = envelope.get("subsumed_from")
         if self.legacy_merge:
             result = self._legacy_merge_frames(payloads)
         else:

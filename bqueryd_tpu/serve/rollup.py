@@ -1,0 +1,294 @@
+"""Materialized-rollup manager: controller-resident hot-plan partials.
+
+The controller watches the stream of admitted, lattice-eligible plans
+(:func:`bqueryd_tpu.serve.subsume.plan_view`), scores each view with an
+exponentially-decaying hit counter, and materializes the ones that stay
+hot: one ``rollup`` verb per holder file builds the mergeable partials
+payload for the view (plus the column census and chunk-prefix fingerprint
+that later proofs need) and ships it back to live here, controller-side.
+
+Freshness is delegated to the PR-14 machinery: every entry stores the
+:func:`~bqueryd_tpu.ops.workingset.table_growth_base` fingerprint its
+partials were computed against, ``note_append`` flips covering entries to
+``stale`` the moment an append for their file is *dispatched* (before any
+row lands — a stale-but-actually-unchanged entry refreshes back to ready,
+never the reverse), and the refresh verb re-validates the stored prefix
+with ``growth_since`` on the worker: exact prefix → aggregate only the
+new tail chunks and hostmerge into the prior partials; any rewrite or
+desync → full rebuild.  A stale or building entry is never served from.
+
+This module is pure bookkeeping — no sockets, no clock reads (callers
+pass ``now``), no numpy — so every lifecycle edge is unit-testable.
+Dispatch and reply absorption live in ``controller.py`` where the wire
+lint can see them.
+"""
+
+import os
+
+
+def _env_int(name, default):
+    try:
+        # bqtpu: allow[config-dynamic-env-key] callers pass literal registered names: ROLLUP_MAX and ROLLUP_CACHE_BYTES below; both in ENV_REGISTRY
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        # bqtpu: allow[config-dynamic-env-key] callers pass literal registered names: ROLLUP_HEAT_MIN and ROLLUP_HEAT_HALFLIFE_S below; both in ENV_REGISTRY
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def rollup_max():
+    """Max live rollup entries (``BQUERYD_TPU_ROLLUP_MAX``)."""
+    return _env_int("BQUERYD_TPU_ROLLUP_MAX", 16)
+
+
+def heat_min():
+    """Decayed hit-score a view must reach before it is materialized
+    (``BQUERYD_TPU_ROLLUP_HEAT_MIN``)."""
+    return _env_float("BQUERYD_TPU_ROLLUP_HEAT_MIN", 3.0)
+
+
+def heat_halflife_s():
+    """Heat decay half-life in seconds (``BQUERYD_TPU_ROLLUP_HEAT_HALFLIFE_S``)."""
+    return _env_float("BQUERYD_TPU_ROLLUP_HEAT_HALFLIFE_S", 300.0)
+
+
+def cache_bytes():
+    """Byte budget for stored rollup partials (``BQUERYD_TPU_ROLLUP_CACHE_BYTES``)."""
+    return _env_int("BQUERYD_TPU_ROLLUP_CACHE_BYTES", 256 * 1024 * 1024)
+
+
+#: seconds after which an unfinished build/refresh is abandoned
+BUILD_TIMEOUT_S = 120.0
+
+
+class RollupEntry:
+    """One materialized view: per-file partials plus the proofs metadata."""
+
+    __slots__ = (
+        "key", "view", "spec", "state", "per_file", "epochs",
+        "started_at", "ready_at", "last_hit", "hits", "nbytes",
+    )
+
+    def __init__(self, key, view, spec, epochs, now):
+        self.key = key
+        self.view = view
+        #: {"args": [keys, agg_list, where_terms], "dag": dag_blob | None}
+        self.spec = spec
+        self.state = "building"
+        #: {fname: {"data", "payload", "base", "zones", "groups", "mode"}}
+        self.per_file = {}
+        #: append-epoch snapshot the stored partials correspond to
+        self.epochs = dict(epochs)
+        self.started_at = now
+        self.ready_at = None
+        self.last_hit = now
+        self.hits = 0
+        self.nbytes = 0
+
+    @property
+    def filenames(self):
+        return self.view["filenames"]
+
+    def group_rows(self):
+        """Total stored partial-group rows across files (fold-cost input)."""
+        return sum(f.get("groups", 0) for f in self.per_file.values())
+
+    def meta(self):
+        """{filename: column census} for the subsumption proofs."""
+        return {f: info.get("zones") or {} for f, info in self.per_file.items()}
+
+    def snapshot(self):
+        """Debug-bundle row."""
+        return {
+            "key": self.key,
+            "state": self.state,
+            "keys": list(self.view["keys"]),
+            "filenames": list(self.filenames),
+            "windowed": self.view.get("dag_sig") is not None,
+            "hits": self.hits,
+            "bytes": self.nbytes,
+            "group_rows": self.group_rows(),
+            "modes": {f: i.get("mode") for f, i in self.per_file.items()},
+        }
+
+
+class RollupManager:
+    """Heat tracking + entry lifecycle.  All mutation goes through the
+    methods below; the controller owns dispatch and absorption."""
+
+    def __init__(self):
+        self._heat = {}          # view_key -> (score, last_seen)
+        self._views = {}         # view_key -> (view, spec), latest eligible shape
+        self.entries = {}        # view_key -> RollupEntry
+        self.file_epochs = {}    # filename -> int, bumped per append dispatch
+        self.evictions = 0
+
+    # -- heat ---------------------------------------------------------
+
+    def note_query(self, key, view, spec, now):
+        """Record one admitted eligible query; returns True when the view
+        just crossed the materialization threshold and has no entry yet."""
+        score, last = self._heat.get(key, (0.0, now))
+        hl = heat_halflife_s()
+        if hl > 0 and now > last:
+            score *= 0.5 ** ((now - last) / hl)
+        score += 1.0
+        self._heat[key] = (score, now)
+        self._views[key] = (view, spec)
+        if len(self._heat) > 4 * max(rollup_max(), 1):
+            self._decay_prune(now)
+        return key not in self.entries and score >= heat_min()
+
+    def _decay_prune(self, now):
+        hl = heat_halflife_s()
+        for key in list(self._heat):
+            score, last = self._heat[key]
+            if hl > 0:
+                score *= 0.5 ** (max(now - last, 0.0) / hl)
+            if score < 0.5 and key not in self.entries:
+                del self._heat[key]
+                self._views.pop(key, None)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start_build(self, key, now):
+        """Create a ``building`` entry for a hot view (idempotent)."""
+        if key in self.entries:
+            return None
+        view, spec = self._views[key]
+        entry = RollupEntry(key, view, spec, {
+            f: self.file_epochs.get(f, 0) for f in view["filenames"]
+        }, now)
+        self.entries[key] = entry
+        return entry
+
+    def absorb(self, key, fname, info, now):
+        """Store one file's build/refresh reply; flips the entry to
+        ``ready`` once every file is in *and* no append arrived meanwhile.
+        Returns the entry state, or None for an unknown/retired key."""
+        entry = self.entries.get(key)
+        if entry is None or fname not in entry.filenames:
+            return None
+        entry.per_file[fname] = info
+        entry.nbytes = sum(
+            len(f.get("data") or b"") for f in entry.per_file.values()
+        )
+        if set(entry.per_file) == set(entry.filenames):
+            current = {f: self.file_epochs.get(f, 0) for f in entry.filenames}
+            if current == entry.epochs:
+                entry.state = "ready"
+                entry.ready_at = now
+            else:
+                # an append was dispatched mid-build: never serve this
+                entry.state = "stale"
+        return entry.state
+
+    def fail(self, key, _reason=None):
+        """Drop an entry whose build/refresh errored."""
+        return self.entries.pop(key, None)
+
+    def note_append(self, filename, now):
+        """An append for ``filename`` is being dispatched: bump the epoch
+        and mark covering entries stale.  Returns the stale-flipped keys."""
+        self.file_epochs[filename] = self.file_epochs.get(filename, 0) + 1
+        flipped = []
+        for entry in self.entries.values():
+            if filename in entry.filenames and entry.state != "building":
+                if entry.state != "stale":
+                    flipped.append(entry.key)
+                entry.state = "stale"
+        return flipped
+
+    def begin_refresh(self, key, now):
+        """Move a stale entry back to ``building`` for a delta refresh;
+        returns (entry, prior_per_file) or None."""
+        entry = self.entries.get(key)
+        if entry is None or entry.state != "stale":
+            return None
+        prior = entry.per_file
+        entry.per_file = {}
+        entry.state = "building"
+        entry.started_at = now
+        entry.epochs = {
+            f: self.file_epochs.get(f, 0) for f in entry.filenames
+        }
+        return entry, prior
+
+    def stale_keys(self):
+        return [k for k, e in self.entries.items() if e.state == "stale"]
+
+    # -- serving ------------------------------------------------------
+
+    def candidates(self, filenames):
+        """Ready entries covering exactly ``filenames`` whose epochs still
+        match — the only entries the lattice may reason over."""
+        out = []
+        for entry in self.entries.values():
+            if entry.state != "ready" or entry.filenames != tuple(filenames):
+                continue
+            current = {f: self.file_epochs.get(f, 0) for f in entry.filenames}
+            if current != entry.epochs:
+                entry.state = "stale"
+                continue
+            out.append(entry)
+        return out
+
+    def note_hit(self, key, now):
+        entry = self.entries.get(key)
+        if entry is not None:
+            entry.hits += 1
+            entry.last_hit = now
+
+    # -- retention ----------------------------------------------------
+
+    def sweep(self, now):
+        """Abandon wedged builds, enforce count + byte caps; returns the
+        evicted/abandoned keys with reasons."""
+        dropped = []
+        for key, entry in list(self.entries.items()):
+            if (
+                entry.state == "building"
+                and now - entry.started_at > BUILD_TIMEOUT_S
+            ):
+                del self.entries[key]
+                dropped.append((key, "build-timeout"))
+        limit = max(rollup_max(), 0)
+        budget = max(cache_bytes(), 0)
+
+        def _victims():
+            live = [e for e in self.entries.values() if e.state != "building"]
+            live.sort(key=lambda e: (e.last_hit, e.hits))
+            return live
+
+        while len(self.entries) > limit:
+            victims = _victims()
+            if not victims:
+                break
+            victim = victims[0]
+            del self.entries[victim.key]
+            self.evictions += 1
+            dropped.append((victim.key, "count-cap"))
+        while sum(e.nbytes for e in self.entries.values()) > budget:
+            victims = _victims()
+            if not victims:
+                break
+            victim = victims[0]
+            del self.entries[victim.key]
+            self.evictions += 1
+            dropped.append((victim.key, "byte-cap"))
+        return dropped
+
+    def snapshot(self):
+        """Debug-bundle ``serving.rollups`` section."""
+        return {
+            "entries": [e.snapshot() for e in self.entries.values()],
+            "tracked_views": len(self._heat),
+            "file_epochs": dict(self.file_epochs),
+            "evictions": self.evictions,
+        }

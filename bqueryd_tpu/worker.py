@@ -1319,6 +1319,122 @@ class WorkerNode(WorkerBase):
         )
         return reply
 
+    def _rollup_census(self, table):
+        """Column metadata the subsumption lattice proves against:
+        per-column kind ("int" columns are null-free by dtype — that is
+        what licenses key-folds), per-chunk zone maps (what licenses
+        zone-proof filter subsumption).  Metadata-only — no chunk decode."""
+        import numpy as np
+
+        from bqueryd_tpu.storage.ctable import KIND_DATETIME, KIND_NUMERIC
+
+        cols = {}
+        for name in table.names:
+            k = table.kind(name)
+            if k == KIND_NUMERIC:
+                np_kind = np.dtype(table.physical_dtype(name)).kind
+                kind = "int" if np_kind in "iu" else "float"
+            elif k == KIND_DATETIME:
+                kind = "datetime"
+            else:
+                kind = "dict"
+            zones = (
+                table.chunk_zone_maps(name)
+                if k in (KIND_NUMERIC, KIND_DATETIME) else None
+            )
+            cols[name] = {
+                "kind": kind,
+                "zones": zones,
+                # float/datetime zone maps skip NaN/NaT rows, so null
+                # absence is only ever provable for integer columns
+                "nulls": kind != "int",
+            }
+        return cols
+
+    def _rollup_build(self, msg):
+        """The controller-originated ``rollup`` verb: materialize (or
+        delta-refresh) the mergeable partials of one hot plan over ONE
+        local shard (serve.rollup).  Refresh requests carry the prior
+        partials plus the chunk-prefix fingerprint they were computed
+        against (``rollup_base``): an exact prefix aggregates only the
+        appended tail chunks and hostmerges them into the prior — the
+        PR-14 delta discipline — while any rewrite/desync (or a windowed
+        plan, whose tail execution path differs) rebuilds from scratch.
+        The reply ships partials bytes, the refreshed fingerprint, and
+        the column census the subsumption proofs need."""
+        from bqueryd_tpu.models.query import GroupByQuery, ResultPayload
+        from bqueryd_tpu.ops import workingset
+        from bqueryd_tpu.parallel import hostmerge
+        from bqueryd_tpu.plan import dag as dagmod
+
+        timer = PhaseTimer()
+        args, _kwargs = msg.get_args_kwargs()
+        filename, groupby_cols, agg_list, where_terms = args[:4]
+        rootdir = os.path.join(self.data_dir, filename)
+        if not os.path.exists(rootdir):
+            raise ValueError(f"Path {rootdir} does not exist")
+        table = self._open_table(rootdir)
+        dag = None
+        if msg.get("dag"):
+            dag = dagmod.OperatorDAG.from_wire(msg.get_from_binary("dag"))
+            dag.sole_payload = False  # rollups store the mergeable form
+            query = dag.plain_groupby_query()
+        else:
+            query = GroupByQuery(
+                groupby_cols, agg_list, where_terms or [], aggregate=True
+            )
+            dag = dagmod.dag_from_query(query)
+            query = dag.plain_groupby_query()
+
+        mode = "rebuild"
+        data = None
+        prior = (
+            msg.get_from_binary("rollup_prior")
+            if msg.get("rollup_prior") else None
+        )
+        base = (
+            msg.get_from_binary("rollup_base")
+            if msg.get("rollup_base") else None
+        )
+        if prior is not None and base is not None and query is not None:
+            new_ids = workingset.growth_since(base, table)
+            if new_ids is not None and not new_ids:
+                mode, data = "fresh", prior
+            elif new_ids is not None:
+                self.engine.timer = timer
+                tail_payload = self.engine.execute_local(
+                    table.chunk_view(new_ids), query
+                )
+                with timer.phase("hostmerge"):
+                    merged = hostmerge.merge_payloads(
+                        [ResultPayload.from_bytes(prior), tail_payload]
+                    )
+                data = ResultPayload(merged).to_bytes()
+                mode = "delta"
+        if data is None:
+            if query is not None:
+                self.engine.timer = timer
+                payload = self.engine.execute_local(table, query)
+            else:
+                payload = self._execute_dag([table], dag, timer)
+            with timer.phase("serialize"):
+                data = payload.to_bytes()
+        self.flight.record(
+            "rollup_build", filename=filename, mode=mode,
+            bytes=len(data), token=msg.get("token"),
+        )
+        reply = msg.copy()
+        reply.pop("params", None)
+        reply.pop("dag", None)
+        reply.pop("rollup_prior", None)
+        reply.pop("rollup_base", None)
+        reply["data"] = data
+        reply["rollup_mode"] = mode
+        reply["phase_timings"] = timer.as_dict()
+        reply.add_as_binary("rollup_base", workingset.table_growth_base(table))
+        reply.add_as_binary("rollup_zones", self._rollup_census(table))
+        return reply
+
     def _execute(self, tables, query, timer, strategy=None):
         """Psum-mergeable aggregations (any shard count) -> mesh executor
         (on-device merge + HBM-resident caches); distinct-count / raw-rows
@@ -1560,6 +1676,8 @@ class WorkerNode(WorkerBase):
             return self.execute_code(msg)
         if msg.isa("append"):
             return self._append_rows(msg)
+        if msg.isa("rollup"):
+            return self._rollup_build(msg)
         if not msg.isa("groupby"):
             return super().handle_work(msg)
         if msg.get("bundle"):

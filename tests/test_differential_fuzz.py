@@ -394,3 +394,91 @@ def test_basket_expansion_matches_pandas(shards, where):
         expanded.append(df[keep])
     expected = _expected(expanded, gcols, agg_list, [])
     _compare(got, expected, gcols, agg_list)
+
+
+# ---------------------------------------------------------------------------
+# semantic serving (PR 16): randomized fold-served answers vs forced recompute
+# ---------------------------------------------------------------------------
+
+# every op here is hostmerge-mergeable, so the candidate rollup's partials
+# can be re-aggregated; v_u64 sums stress the mod-2^64 limb path through
+# the fold's collapse exactly like a cross-shard merge would
+SERVE_AGG_POOL = [
+    ["v_small", "sum", "s"],
+    ["v_float", "mean", "m"],
+    ["v_small", "count", "n"],
+    ["v_float", "min", "lo"],
+    ["v_big", "max", "hi"],
+    ["v_u64", "sum", "su"],
+]
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_serving_fold_matches_forced_recompute(shards, seed):
+    """Randomized plan-subsumption serving (PR 16) at the engine level: a
+    finer-keyed candidate rollup holding the full agg pool is matched
+    against a random coarser query through the lattice, the resulting
+    fold transform is applied per shard, and the hostmerged answer must
+    agree with pandas (= the forced-recompute oracle) — bit-exact for
+    integer aggregates, allclose for floats."""
+    from bqueryd_tpu.models.query import ResultPayload
+    from bqueryd_tpu.serve import subsume
+
+    frames, tables = shards
+    rng = np.random.default_rng(9000 + seed)
+    droppable = ["k_int", "k_wide"]  # null-free int keys: fold-eligible
+    cand_keys = list(droppable)
+    if rng.random() < 0.5:
+        cand_keys.append("k_str")  # dict key: must survive every fold
+    drop = [k for k in droppable if rng.random() < 0.5]
+    query_keys = [k for k in cand_keys if k not in drop]
+    if not query_keys:
+        query_keys = [cand_keys[0]]
+    pick = sorted(
+        rng.choice(
+            len(SERVE_AGG_POOL),
+            size=int(rng.integers(1, len(SERVE_AGG_POOL) + 1)),
+            replace=False,
+        )
+    )
+    query_aggs = [SERVE_AGG_POOL[i] for i in pick]
+
+    def _view(keys, aggs):
+        return {
+            "filenames": ("all",),
+            "keys": tuple(keys),
+            "aggs": tuple(tuple(a) for a in aggs),
+            "where": (),
+            "aggregate_rows": True,
+            "expand": None,
+            "dag_sig": None,
+        }
+
+    meta = {
+        "all": {
+            k: {"kind": "int", "zones": None, "nulls": False}
+            for k in droppable
+        }
+    }
+    transform, why = subsume.match(
+        _view(cand_keys, SERVE_AGG_POOL), _view(query_keys, query_aggs), meta
+    )
+    assert why is None, why
+
+    cand_query = GroupByQuery(
+        cand_keys, SERVE_AGG_POOL, [], aggregate=True
+    )
+    engine = QueryEngine()
+    served = [
+        ResultPayload(
+            subsume.apply_transform(
+                dict(engine.execute_local(t, cand_query)), transform
+            )
+        )
+        for t in tables
+    ]
+    got = hostmerge.payload_to_dataframe(hostmerge.merge_payloads(served))
+    _compare(
+        got, _expected(frames, query_keys, query_aggs, []),
+        query_keys, query_aggs,
+    )

@@ -531,7 +531,7 @@ def test_e2e_wedge_health_routing_and_debug_bundle(forensics_cluster):
         return bundle
 
     bundle = wait_until(bundle_ready, desc="bundle with fresh debug slices")
-    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/3"
+    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/4"
     assert bundle["trace_id"] == trace_id
     # flight ring: the wedge event is in the artifact, alongside the
     # normal-flow envelope/dispatch/outcome events
@@ -628,7 +628,7 @@ def test_e2e_sigusr1_dump_writes_bundle(forensics_cluster, tmp_path,
     dumps = list(tmp_path.glob("bqueryd_tpu_debug_controller_*.json"))
     assert len(dumps) == 1
     bundle = json.loads(dumps[0].read_text())
-    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/3"
+    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/4"
 
 
 def test_e2e_partial_bundle_after_worker_death(forensics_cluster):

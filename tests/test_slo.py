@@ -656,7 +656,7 @@ def test_debug_bundle_carries_new_sections(slo_cluster):
     rpc.groupby(slo_cluster["shards"], ["g"], [["v", "sum", "s"]], [])
     trace_id = rpc.last_trace_id  # every rpc call re-mints last_trace_id
     bundle = rpc.debug_bundle(trace_id)
-    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/3"
+    assert bundle["schema"] == "bqueryd_tpu.debug_bundle/4"
     controller_section = bundle["controller"]
     # the autopsy of the bundled trace rides inline
     assert controller_section["autopsy"]["trace_id"] == trace_id
